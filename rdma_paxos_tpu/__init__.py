@@ -1,0 +1,45 @@
+"""rdma_paxos_tpu — a TPU-native replicated-state-machine framework.
+
+A ground-up rebuild of the capabilities of APUS / RDMA-PAXOS
+(wnagchenghku/RDMA-PAXOS): transparent state-machine replication of
+unmodified TCP server applications via LD_PRELOAD interposition, backed by a
+DARE-style strong-leader consensus core — except the log-replication hot loop
+runs as JAX collectives over TPU ICI (one replica per chip) instead of
+one-sided RDMA verbs.
+
+Architecture (TPU-first, not a port — see SURVEY.md §7):
+
+- ``consensus/``  — the replicated log (fixed-shape on-device ring buffer)
+  and the SPMD replica step: batched append, leader fan-out (masked-psum
+  broadcast — the analog of the one-sided RDMA WRITE of
+  ``rc_write_remote_logs``, reference ``src/dare/dare_ibv_rc.c:1870``),
+  term-gated accept + divergence truncation (the analog of
+  ``log_adjustment``, ``dare_ibv_rc.c:1292``), ACK gather, majority-quorum
+  commit, one-round leader election, heartbeats — all one jitted collective
+  program over a ``replica`` mesh axis.
+- ``ops/``        — Pallas TPU kernels for the hot scans (quorum/commit).
+- ``parallel/``   — mesh construction, shard_map wrapper, and a
+  ``vmap(axis_name=...)`` emulation path so the identical protocol code runs
+  N replicas on a single chip or one replica per chip on a slice.
+- ``runtime/``    — host control plane: per-replica driver loop (the libev
+  ``polling()`` analog, reference ``src/dare/dare_server.c:1004``), timers
+  with adaptive election timeout (``to_adjust_cb``, ``dare_server.c:763``),
+  membership/bootstrap over TCP (the UD/multicast analog,
+  ``src/dare/dare_ibv_ud.c``), snapshot recovery.
+- ``proxy/``      — RSM client / replay engine (reference
+  ``src/proxy/proxy.c``): connection-id map, event queue → device batch
+  marshalling, follower loopback-TCP replay, stable store.
+- ``models/``     — built-in replicated state machines (device-native KVS,
+  the ``dare_kvs_sm`` analog, reference ``src/dare/dare_kvs_sm.c``).
+- ``native/``     — C++ runtime pieces: the LD_PRELOAD interposition shim
+  (reference ``src/spec_hooks.cpp``) and the append-only stable store
+  (reference ``src/db/db-interface.c``), bound via ctypes.
+"""
+
+__version__ = "0.1.0"
+
+from rdma_paxos_tpu.config import (  # noqa: F401
+    LogConfig,
+    TimeoutConfig,
+    ClusterConfig,
+)

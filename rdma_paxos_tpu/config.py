@@ -1,0 +1,141 @@
+"""Static configuration for the TPU-native consensus core.
+
+The reference splits configuration across three mechanisms (SURVEY.md §5):
+libconfig ``nodes.local.cfg`` (timing block, reference
+``src/config-comp/config-dare.c:12-54``), env vars for per-instance identity
+(``server_idx``, ``group_size``, ... — ``src/proxy/proxy.c:33-59``), and
+compile-time constants (``LOG_SIZE`` ``src/include/dare/dare_log.h:76``,
+``MAX_SERVER_COUNT`` ``src/include/dare/dare.h:26``).
+
+Here everything that shapes compiled programs is a frozen dataclass — JAX
+programs are traced once per static config, so these play the role of the
+reference's compile-time constants, while remaining per-cluster values.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+
+# ---------------------------------------------------------------------------
+# Log geometry
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class LogConfig:
+    """Geometry of the on-device replicated log.
+
+    The reference log is a byte-granular 64 MB circular buffer with
+    variable-size entries and wrap-around splitting rules
+    (``dare_log.h:76,466-558``). Byte-granular variable-size framing is
+    hostile to XLA (dynamic shapes, scalar loops), so the TPU-native log is
+    **slot-based**: fixed-size slots addressed by a global monotone entry
+    index; slot for global index ``g`` is ``g % n_slots``. Payloads larger
+    than one slot are fragmented by the proxy into multiple SEND entries —
+    semantically free for APUS, because replay is a byte stream and the
+    concatenation of fragments reproduces the identical bytes in log order
+    (reference replay: ``src/proxy/proxy.c:408-423``).
+
+    All four log offsets of the reference (``head/apply/commit/end``,
+    ``dare_log.h:77-103``) survive as global monotone int32 entry indices.
+    """
+
+    n_slots: int = 1024          # entries in the ring (reference: 64MB buffer)
+    slot_bytes: int = 512        # payload bytes per slot (proxy fragments above)
+    window_slots: int = 128      # max entries moved leader->followers per step
+    batch_slots: int = 64        # max entries appended by the leader per step
+
+    def __post_init__(self) -> None:
+        if self.n_slots & (self.n_slots - 1):
+            raise ValueError("n_slots must be a power of two")
+        if self.slot_bytes % 4:
+            raise ValueError("slot_bytes must be a multiple of 4")
+        if self.window_slots > self.n_slots:
+            raise ValueError("window_slots must be <= n_slots")
+        if self.batch_slots > self.window_slots:
+            raise ValueError("batch_slots must be <= window_slots")
+
+    @property
+    def slot_words(self) -> int:
+        return self.slot_bytes // 4
+
+
+# ---------------------------------------------------------------------------
+# Protocol timing (host control plane)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class TimeoutConfig:
+    """Timing block — mirrors the ``dare_global_config`` section of
+    ``nodes.local.cfg`` (reference ``target/nodes.local.cfg:22-35``,
+    parsed by ``src/config-comp/config-dare.c:20-44``).
+
+    Values are seconds. The defaults mirror the reference's DEBUG profile
+    (hb 10 ms, election 100–300 ms); the production profile in the reference
+    is hb 1 ms, election 10–30 ms.
+    """
+
+    hb_period: float = 0.010
+    elec_timeout_low: float = 0.100
+    elec_timeout_high: float = 0.300
+    retransmit_period: float = 0.040
+    rc_info_period: float = 0.050      # membership/bootstrap gossip period
+    log_pruning_period: float = 0.050
+
+    @classmethod
+    def production(cls) -> "TimeoutConfig":
+        return cls(hb_period=0.001, elec_timeout_low=0.010,
+                   elec_timeout_high=0.030)
+
+
+# ---------------------------------------------------------------------------
+# Cluster identity / membership
+# ---------------------------------------------------------------------------
+
+MAX_SERVER_COUNT = 13   # reference src/include/dare/dare.h:26
+
+
+@dataclasses.dataclass(frozen=True)
+class ClusterConfig:
+    """Per-instance identity + group shape.
+
+    The reference passes these through env vars (``server_idx``,
+    ``group_size``, ``server_type``, ``config_path``, ``dare_log_file``,
+    ``mgid`` — ``src/proxy/proxy.c:33-59``); :meth:`from_env` accepts the
+    same names so drivers written against the reference's launch convention
+    (``benchmarks/run.sh:24-33``) keep working.
+    """
+
+    server_idx: int = 0
+    group_size: int = 3
+    server_type: str = "start"          # "start" | "join"
+    config_path: Optional[str] = None
+    log_file: Optional[str] = None
+    # DCN bootstrap: "host:port" of every replica's control endpoint
+    # (the analog of the IB multicast group, dare_ibv_ud.h:25).
+    peers: tuple = ()
+
+    def __post_init__(self) -> None:
+        if not (1 <= self.group_size <= MAX_SERVER_COUNT):
+            raise ValueError(
+                f"group_size must be in [1, {MAX_SERVER_COUNT}]")
+        if self.server_type not in ("start", "join"):
+            raise ValueError("server_type must be 'start' or 'join'")
+
+    @classmethod
+    def from_env(cls, env: Optional[dict] = None) -> "ClusterConfig":
+        e = os.environ if env is None else env
+        return cls(
+            server_idx=int(e.get("server_idx", 0)),
+            group_size=int(e.get("group_size", 3)),
+            server_type=e.get("server_type", "start"),
+            config_path=e.get("config_path"),
+            log_file=e.get("dare_log_file"),
+            peers=tuple(p for p in e.get("peers", "").split(",") if p),
+        )
+
+    @property
+    def majority(self) -> int:
+        return self.group_size // 2 + 1

@@ -1,0 +1,19 @@
+from rdma_paxos_tpu.consensus.log import (  # noqa: F401
+    Log,
+    EntryType,
+    make_log,
+    append_batch,
+    extract_window,
+    absorb_window,
+)
+from rdma_paxos_tpu.consensus.state import (  # noqa: F401
+    Role,
+    ReplicaState,
+    make_replica_state,
+)
+from rdma_paxos_tpu.consensus.step import (  # noqa: F401
+    StepInput,
+    StepOutput,
+    replica_step,
+    make_step_input,
+)

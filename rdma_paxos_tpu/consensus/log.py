@@ -1,0 +1,220 @@
+"""The replicated log as fixed-shape on-device arrays.
+
+Reference: the DARE log is a byte-granular 64 MB circular buffer, remotely
+writable via one-sided RDMA, with four offsets ``head/apply/commit/end`` and
+entry framing ``{idx, term, req_id, clt_id, type, reply[], data}``
+(``src/include/dare/dare_log.h:33-47,76-103``) plus wrap-around splitting
+rules (``dare_log.h:466-558``).
+
+TPU-native redesign (NOT a translation):
+
+* **Slot-based ring, SoA layout.** Fixed-size slots; payload lives in an
+  ``[n_slots, slot_words] int32`` array, per-entry metadata in an
+  ``[n_slots, META_W] int32`` array (struct-of-arrays — XLA/VPU-friendly,
+  where the reference packs variable-size structs into a byte buffer).
+  Oversize payloads are fragmented by the proxy into consecutive SEND
+  entries, which is semantically lossless for stream replay.
+* **Global monotone indices.** ``head/apply/commit/end`` are monotonically
+  increasing int32 *entry* indices; the slot of global index ``g`` is
+  ``g % n_slots``. The reference's wrap-around entry-splitting machinery
+  (``dare_log.h:496-545``) disappears: wrap is a single cheap mask, and the
+  two-segment RDMA write on wrap (``dare_ibv_rc.c:1539-1545``) becomes a
+  gather/scatter with modular indices.
+* **No reply[] array in the entry.** The reference embeds a per-entry ACK
+  byte-array that followers RDMA-write into the leader's log
+  (``dare_log.h:44``). On TPU, acknowledgement is an ``all_gather`` of
+  follower ``end`` offsets (see ``consensus/step.py``) — the per-entry ACK
+  bitmap materializes only inside the quorum kernel (``ops/quorum.py``).
+
+Everything here is pure and shape-static: callable under ``jit``, ``vmap``
+and ``shard_map``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from rdma_paxos_tpu.config import LogConfig
+
+
+class EntryType(enum.IntEnum):
+    """Log entry types — reference ``dare_log.h:22-25`` (NOOP/CSM/CONFIG/HEAD)
+    plus proxy event types carried in CSM entries (CONNECT/SEND/CLOSE,
+    reference ``src/include/dare/message.h``)."""
+
+    EMPTY = 0       # unwritten slot
+    NOOP = 1        # blank entry appended by a fresh leader (dare_server.c:1487)
+    CONNECT = 2     # proxy: new client connection     (proxy.c:163-228)
+    SEND = 3        # proxy: client payload bytes      (proxy.c:230-239)
+    CLOSE = 4       # proxy: connection closed         (proxy.c:241-261)
+    CONFIG = 5      # membership change                (dare_log.h:24)
+    HEAD = 6        # log-pruning head advancement     (dare_log.h:25)
+
+
+# Metadata columns (SoA): meta[slot, col]
+M_TYPE, M_TERM, M_CONN, M_REQID, M_LEN = 0, 1, 2, 3, 4
+META_W = 8  # padded for alignment
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class Log:
+    """Per-replica log arrays. ``data[g % n_slots]`` holds the payload of the
+    entry with global index ``g``; ``meta`` its framing."""
+
+    data: jax.Array   # [n_slots, slot_words] int32
+    meta: jax.Array   # [n_slots, META_W] int32
+
+    @property
+    def n_slots(self) -> int:
+        return self.data.shape[0]
+
+
+def make_log(cfg: LogConfig) -> Log:
+    return Log(
+        data=jnp.zeros((cfg.n_slots, cfg.slot_words), jnp.int32),
+        meta=jnp.zeros((cfg.n_slots, META_W), jnp.int32),
+    )
+
+
+def slot_of(g: jax.Array, n_slots: int) -> jax.Array:
+    """Slot index of global entry index ``g`` (n_slots is a power of two)."""
+    return jnp.bitwise_and(g, n_slots - 1)
+
+
+def last_term(log: Log, end: jax.Array) -> jax.Array:
+    """Term of the last entry (0 for an empty log) — used for the election
+    up-to-date check (reference ``dare_server.c:1596-1652``)."""
+    t = log.meta[slot_of(end - 1, log.n_slots), M_TERM]
+    return jnp.where(end > 0, t, 0)
+
+
+# ---------------------------------------------------------------------------
+# Append (leader)
+# ---------------------------------------------------------------------------
+
+def append_batch(
+    log: Log,
+    end: jax.Array,
+    head: jax.Array,
+    batch_data: jax.Array,   # [B, slot_words] int32
+    batch_meta: jax.Array,   # [B, META_W] int32 (M_TERM overwritten here)
+    count: jax.Array,        # scalar int32, entries actually present (<= B)
+    term: jax.Array,         # scalar int32, leader's current term
+) -> Tuple[Log, jax.Array]:
+    """Append up to ``count`` entries at ``end`` stamped with ``term``.
+
+    The capacity clamp enforces the reference's invariant that appends never
+    overtake ``head`` (``log_append_entry``'s free-space check,
+    ``dare_log.h:466-558``); entries that do not fit are dropped here and the
+    proxy retries them next step (the reference instead forces log pruning,
+    ``dare_server.c:2069-2122`` — our host driver does the same by feeding
+    apply offsets forward, see ``consensus/step.py``).
+
+    Returns ``(log', new_end)``.
+    """
+    n_slots = log.n_slots
+    B = batch_data.shape[0]
+    # Capacity is n_slots-1 (one slot always kept free) so that for any
+    # window start >= head, slot(wstart-1) still physically holds entry
+    # wstart-1 — the AppendEntries prev-term check in the step never reads
+    # a recycled slot.
+    avail = (n_slots - 1) - (end - head)
+    n = jnp.clip(jnp.minimum(count, avail), 0, B).astype(jnp.int32)
+
+    offs = jnp.arange(B, dtype=jnp.int32)
+    valid = offs < n
+    # out-of-range index => dropped by scatter mode="drop"
+    idx = jnp.where(valid, slot_of(end + offs, n_slots), n_slots)
+
+    meta = batch_meta.at[:, M_TERM].set(term)
+    new_data = log.data.at[idx].set(batch_data, mode="drop")
+    new_meta = log.meta.at[idx].set(meta, mode="drop")
+    return Log(new_data, new_meta), end + n
+
+
+# ---------------------------------------------------------------------------
+# Window extract (leader fan-out) / absorb (follower accept)
+# ---------------------------------------------------------------------------
+
+def extract_window(
+    log: Log, start: jax.Array, window_slots: int
+) -> Tuple[jax.Array, jax.Array]:
+    """Gather ``window_slots`` consecutive entries beginning at global index
+    ``start`` into dense ``[W, ...]`` arrays.
+
+    This is the replication payload the leader broadcasts — the analog of the
+    RDMA WRITE of ``log[remote_end : end]`` (reference
+    ``dare_ibv_rc.c:1526-1642``); the ring wrap that costs the reference two
+    RDMA sends (``:1539-1545``) is absorbed by the modular gather.
+    """
+    idx = slot_of(start + jnp.arange(window_slots, dtype=jnp.int32),
+                  log.n_slots)
+    return log.data[idx], log.meta[idx]
+
+
+def absorb_window(
+    log: Log,
+    my_end: jax.Array,
+    wdata: jax.Array,     # [W, slot_words]
+    wmeta: jax.Array,     # [W, META_W]
+    wstart: jax.Array,    # global index of window[0]
+    wcount: jax.Array,    # valid entries in the window
+) -> Tuple[Log, jax.Array]:
+    """Follower-side accept: merge a leader window into the local log.
+
+    Implements the log-adjustment semantics of the reference
+    (``log_adjustment`` steps LR_GET_WRITE→…→SET_END,
+    ``dare_ibv_rc.c:1292-1451``; NC-buffer determinants,
+    ``dare_log.h:58-65,339-359``) as pure data flow:
+
+    * **Gap gate**: if ``wstart > my_end`` the follower cannot verify
+      continuity and ignores the window (it will be covered next step, since
+      the leader floors the window at the minimum active ``end``).
+    * **Divergence truncation**: in the overlap ``[wstart, min(my_end,
+      wend))`` compare per-entry terms; at the first mismatch the local
+      suffix is stale (uncommitted entries of a deposed leader) and is
+      discarded — the window contents replace it. With no mismatch a shorter
+      window never truncates a longer log.
+    * **Copy**: all valid window entries are scattered in (overwriting
+      matching prefixes with identical bytes is a no-op).
+
+    Term gating (stale-leader fencing — the analog of the QP revoke fencing,
+    ``rc_revoke_log_access`` ``dare_ibv_rc.c:2156-2255``) happens in the
+    caller (``consensus/step.py``): a window stamped with an old term never
+    reaches this function.
+
+    Returns ``(log', new_end)``.
+    """
+    n_slots = log.n_slots
+    W = wdata.shape[0]
+    offs = jnp.arange(W, dtype=jnp.int32)
+    g = wstart + offs                       # global index per window position
+    valid = offs < wcount
+    wend = wstart + wcount
+
+    accept = wstart <= my_end
+
+    # --- divergence scan over the overlap ---
+    local_terms = log.meta[slot_of(g, n_slots), M_TERM]
+    in_overlap = valid & (g < my_end)
+    mismatch = in_overlap & (local_terms != wmeta[:, M_TERM])
+    any_conflict = jnp.any(mismatch)
+
+    # --- scatter the window in ---
+    do_copy = valid & accept
+    idx = jnp.where(do_copy, slot_of(g, n_slots), n_slots)
+    new_data = log.data.at[idx].set(wdata, mode="drop")
+    new_meta = log.meta.at[idx].set(wmeta, mode="drop")
+
+    new_end = jnp.where(
+        accept,
+        jnp.where(any_conflict, wend, jnp.maximum(my_end, wend)),
+        my_end,
+    ).astype(jnp.int32)
+    return Log(new_data, new_meta), new_end

@@ -1,0 +1,100 @@
+"""Per-replica consensus state.
+
+The reference derives a replica's role from a single 64-bit SID
+``[TERM | L | IDX]`` updated by CAS (``src/include/dare/dare_server.h:46-72``,
+macros ``src/dare/dare_server.c:42-53``, ``server_update_sid``
+``:2288-2297``). The CAS exists because app threads and the DARE thread race
+on it; in the TPU design the state is only ever updated inside the jitted
+replica step (single logical writer per replica), so the SID unpacks into
+plain fields: ``term``, ``leader_id``, ``role``.
+
+Membership is a bitmask configuration with dual-quorum transitional states,
+exactly the reference's ``cid`` (``src/include/dare/dare_config.h:17-44``):
+``CID_STABLE`` needs one majority over ``bitmask_new``; ``CID_TRANSIT``
+needs majorities over both ``bitmask_old`` and ``bitmask_new``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+
+import jax
+import jax.numpy as jnp
+
+from rdma_paxos_tpu.config import LogConfig
+from rdma_paxos_tpu.consensus.log import Log, make_log
+
+
+class Role(enum.IntEnum):
+    """Reference ``dare_server.h`` roles (NONE/FOLLOWER/CANDIDATE/LEADER)."""
+
+    NONE = 0        # not an active member (joiner before CONFIG commit)
+    FOLLOWER = 1
+    CANDIDATE = 2
+    LEADER = 3
+
+
+class ConfigState(enum.IntEnum):
+    """Membership-change configuration states — reference
+    ``dare_config.h:17-24`` (CID_STABLE / CID_TRANSIT / CID_EXTENDED)."""
+
+    STABLE = 0
+    TRANSIT = 1     # joint consensus: both masks must reach majority
+    EXTENDED = 2    # group up-size announced, not yet transitional
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class ReplicaState:
+    """Everything one replica carries between steps (one pytree per device).
+
+    Log offsets are global monotone entry indices with the reference's
+    invariant chain ``head <= apply <= commit <= end``
+    (``dare_log.h:77-103``).
+    """
+
+    log: Log
+    # --- SID fields (dare_server.h:46-72) ---
+    term: jax.Array         # i32 — current term
+    role: jax.Array         # i32 — Role
+    leader_id: jax.Array    # i32 — known leader, -1 if none
+    # --- election durability (rc_replicate_vote, dare_ibv_rc.c:1049) ---
+    voted_term: jax.Array   # i32 — highest term in which we voted
+    voted_for: jax.Array    # i32 — candidate voted for in voted_term
+    # --- log offsets (dare_log.h:77-103) ---
+    head: jax.Array         # i32 — oldest retained entry
+    apply: jax.Array        # i32 — applied up to here (host echoes back)
+    commit: jax.Array       # i32 — committed up to here (monotone)
+    end: jax.Array          # i32 — next append position
+    # --- membership (dare_config.h:26-44) ---
+    cid_state: jax.Array    # i32 — ConfigState
+    bitmask_old: jax.Array  # u32 — member bitmask (old config)
+    bitmask_new: jax.Array  # u32 — member bitmask (new/current config)
+    epoch: jax.Array        # i32 — config epoch (bumped per change)
+
+
+def make_replica_state(
+    cfg: LogConfig,
+    group_size: int,
+    *,
+    role: Role = Role.FOLLOWER,
+) -> ReplicaState:
+    i32 = lambda v: jnp.asarray(v, jnp.int32)
+    mask = jnp.asarray((1 << group_size) - 1, jnp.uint32)
+    return ReplicaState(
+        log=make_log(cfg),
+        term=i32(0),
+        role=i32(int(role)),
+        leader_id=i32(-1),
+        voted_term=i32(0),
+        voted_for=i32(-1),
+        head=i32(0),
+        apply=i32(0),
+        commit=i32(0),
+        end=i32(0),
+        cid_state=i32(int(ConfigState.STABLE)),
+        bitmask_old=mask,
+        bitmask_new=mask,
+        epoch=i32(0),
+    )

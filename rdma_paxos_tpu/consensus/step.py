@@ -1,0 +1,430 @@
+"""The SPMD replica step — the entire DARE protocol as ONE collective program.
+
+The reference drives consensus from a libev event loop (``polling()``,
+``src/dare/dare_server.c:1004-1125``) issuing one-sided RDMA verbs per peer:
+log adjustment (``dare_ibv_rc.c:1292-1451``), log-delta writes
+(``:1465-1826``), per-entry ACK replies (``:1828-1863``), vote requests
+(``:969-1043``), heartbeats (``:868-912``), QP-reset fencing
+(``:2156-2255``). Followers' CPUs are passive in the replication hot path.
+
+TPU-native redesign: all replicas advance in lock-step through a single
+jitted SPMD step over a 1-D ``replica`` mesh axis (one replica per chip).
+Every asymmetric, per-peer interaction of the reference becomes *data* inside
+a uniform program (SURVEY.md §7 "model follower lag as data"):
+
+=====================================  =======================================
+reference mechanism                     TPU-native equivalent (here)
+=====================================  =======================================
+RDMA WRITE of log delta per follower   leader window ``all_gather`` + local
+(``update_remote_logs``)               term-gated ``absorb_window``
+log adjustment / NC determinants       prev-term consistency check + data-
+(``log_adjustment``)                   driven end backoff (AppendEntries rule)
+per-entry ACK reply[] bytes            ``all_gather`` of verified match
+(``rc_send_entries_reply``)            offsets (acks)
+commit scan + majority count           ``ops.quorum.commit_scan`` (Pallas)
+(``dare_ibv_rc.c:1725-1758``)
+lazy commit push to followers          leader commit scalar rides the window
+(``:1760-1819``)                       message (one-step lazy, like the ref)
+HB RDMA write of SID into hb[]         window message with wcount==0
+(``rc_send_hb``)                       (term+commit are the heartbeat)
+QP RESET fencing of deposed leaders    term gating: a stale leader's window
+(``rc_revoke_log_access``)             is never selected (dominant-leader
+                                       rule) and never absorbed (term gate)
+vote request / vote ack RDMA writes    one-round election: candidacy in the
+(``rc_send_vote_request/_ack``)        control gather, votes in a second
+                                       gather, winner derived locally
+per-follower LR step state machines    none needed — lock-step; laggards are
+(``handle_lr_work_completion``)        expressed by window flooring + acks
+dual-quorum transitional configs       dual bitmask quorum in vote counting
+(``dare_ibv_rc.c:2799-2957``)          and in the commit kernel
+log pruning via remote apply offsets   min-of-applies head advance riding the
+(``dare_server.c:1976-2122``)          control gather + window message
+=====================================  =======================================
+
+Failure semantics: ``peer_mask`` is each replica's local view of which peers
+are reachable. On a real slice all-ones (an ICI chip failure kills the whole
+SPMD program and is handled by the host layer: mesh rebuild + recovery from
+stable storage). In simulation the mask models partitions/crashes exactly —
+gathered rows from unheard peers are ignored, so a partitioned stale leader
+can keep appending locally but can neither replicate nor commit (it lacks a
+quorum), and steps down the moment it hears a higher term.
+
+Collective cost per step: 3 small ``all_gather`` (control, votes, acks) + 1
+window ``all_gather`` (W·slot_bytes per contributor). The window gather is
+deliberately an all_gather rather than a masked ``psum`` so that split-brain
+double-contribution cannot corrupt the payload — receivers *select* the
+dominant leader's row.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from rdma_paxos_tpu.config import LogConfig
+from rdma_paxos_tpu.consensus.log import (
+    EntryType, Log, M_TERM, M_TYPE, META_W,
+    append_batch, absorb_window, extract_window, last_term, slot_of,
+)
+from rdma_paxos_tpu.consensus.state import ConfigState, ReplicaState, Role
+from rdma_paxos_tpu.ops.quorum import R_PAD, commit_scan
+
+I32_MIN = jnp.iinfo(jnp.int32).min
+I32_MAX = jnp.iinfo(jnp.int32).max
+
+# control-gather columns
+C_TERM, C_ROLE, C_END, C_COMMIT, C_LTERM, C_APPLY, C_TMO, C_N = range(8)
+# window-message scalar columns
+S_VALID, S_WSTART, S_WCOUNT, S_TERM, S_PREV, S_COMMIT, S_HEAD, S_N = range(8)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class StepInput:
+    """Per-replica host→device inputs for one step."""
+
+    batch_data: jax.Array    # [B, slot_words] i32 — client entries (leader)
+    batch_meta: jax.Array    # [B, META_W] i32
+    batch_count: jax.Array   # i32 — valid entries in the batch
+    timeout_fired: jax.Array  # i32 — host election timer expired
+    peer_mask: jax.Array     # [R] i32 — which peers this replica can hear
+    apply_done: jax.Array    # i32 — host's applied index (echo)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class StepOutput:
+    """Per-replica device→host results of one step (small scalars only; bulk
+    committed payload is fetched separately, see ``fetch_window``)."""
+
+    term: jax.Array
+    role: jax.Array
+    leader_id: jax.Array
+    head: jax.Array
+    apply: jax.Array
+    commit: jax.Array
+    end: jax.Array
+    hb_seen: jax.Array        # leader heartbeat arrived — reset election timer
+    became_leader: jax.Array  # this replica won an election this step
+    acked: jax.Array          # absorbed/verified the leader window this step
+    accepted: jax.Array       # client entries actually appended from the
+                              # batch (< batch_count ⟹ ring full: RETRY rest)
+
+
+def make_step_input(cfg: LogConfig, n_replicas: int) -> StepInput:
+    """An idle (no client traffic, no timeout) input."""
+    i32 = jnp.int32
+    return StepInput(
+        batch_data=jnp.zeros((cfg.batch_slots, cfg.slot_words), i32),
+        batch_meta=jnp.zeros((cfg.batch_slots, META_W), i32),
+        batch_count=jnp.zeros((), i32),
+        timeout_fired=jnp.zeros((), i32),
+        peer_mask=jnp.ones((n_replicas,), i32),
+        apply_done=jnp.zeros((), i32),
+    )
+
+
+def _lex_argmax(valid: jax.Array, keys) -> jax.Array:
+    """Index of the lexicographically-largest row among ``valid`` ones
+    (ties → smallest index); -1 if none valid."""
+    v = valid
+    for k in keys:
+        kk = jnp.where(v, k, I32_MIN)
+        v = v & (kk == jnp.max(kk))
+    return jnp.where(jnp.any(v), jnp.argmax(v).astype(jnp.int32), -1)
+
+
+def _popcount_vec(bitmask: jax.Array, n: int) -> jax.Array:
+    """[n] membership 0/1 vector from a bitmask."""
+    r = jnp.arange(n, dtype=jnp.uint32)
+    return jnp.bitwise_and(jnp.right_shift(bitmask, r), 1).astype(jnp.int32)
+
+
+def replica_step(
+    state: ReplicaState,
+    inp: StepInput,
+    *,
+    cfg: LogConfig,
+    n_replicas: int,
+    axis_name: str = "replica",
+    use_pallas: bool = False,
+    interpret: bool = False,
+) -> Tuple[ReplicaState, StepOutput]:
+    """One protocol step for this replica (call under ``shard_map`` over the
+    ``replica`` mesh axis, or under ``vmap(axis_name=...)`` for single-chip
+    simulation — see ``parallel/mesh.py``)."""
+    i32 = jnp.int32
+    R, W = n_replicas, cfg.window_slots
+    me = lax.axis_index(axis_name).astype(i32)
+    heard = inp.peer_mask.astype(bool)                      # [R]
+
+    in_new = _popcount_vec(state.bitmask_new, R)            # [R] 0/1
+    in_old = _popcount_vec(state.bitmask_old, R)
+    maj_new = jnp.sum(in_new) // 2 + 1
+    maj_old = jnp.sum(in_old) // 2 + 1
+    transit = (state.cid_state == int(ConfigState.TRANSIT)).astype(i32)
+    # During joint consensus, old-config members must still vote (the win
+    # condition demands a majority of BOTH configs — dare_server.c:1366-1373)
+    i_member = (in_new[me] > 0) | ((transit > 0) & (in_old[me] > 0))
+    my_lterm = last_term(state.log, state.end)
+
+    # ------------------------------------------------------------------
+    # Phase A — control gather (terms, roles, offsets, candidacies,
+    # apply offsets for pruning).  The analog of reading peers' cached
+    # SIDs / ctrl arrays (dare_ibv_rc.c:1182-1280).
+    # ------------------------------------------------------------------
+    ctrl = jnp.zeros((C_N,), i32)
+    ctrl = ctrl.at[C_TERM].set(state.term)
+    ctrl = ctrl.at[C_ROLE].set(state.role)
+    ctrl = ctrl.at[C_END].set(state.end)
+    ctrl = ctrl.at[C_COMMIT].set(state.commit)
+    ctrl = ctrl.at[C_LTERM].set(my_lterm)
+    ctrl = ctrl.at[C_APPLY].set(jnp.minimum(inp.apply_done, state.commit))
+    ctrl = ctrl.at[C_TMO].set(inp.timeout_fired)
+    allc = lax.all_gather(ctrl, axis_name)                  # [R, C_N]
+
+    g_term, g_end = allc[:, C_TERM], allc[:, C_END]
+    g_lterm, g_apply = allc[:, C_LTERM], allc[:, C_APPLY]
+    g_tmo = allc[:, C_TMO]
+
+    # ------------------------------------------------------------------
+    # Phase B — one-round election (start_election dare_server.c:1264,
+    # voting :1526-1743, counting :1327-1518 — collapsed to one step).
+    # ------------------------------------------------------------------
+    is_cand = (g_tmo > 0) & (in_new > 0)                    # [R]
+    cand_term = g_term + 1
+    i_cand = is_cand[me] & (state.role != int(Role.LEADER))
+
+    # voter logic (vote durability: the all_gather below IS the vote
+    # replication of rc_replicate_vote; the host additionally persists
+    # voted_term/voted_for to stable storage between steps)
+    can_grant = (
+        heard & is_cand
+        & (cand_term >= state.term)
+        & ((cand_term > state.voted_term)
+           | ((cand_term == state.voted_term)
+              & (jnp.arange(R) == state.voted_for)))
+        & ((g_lterm > my_lterm)
+           | ((g_lterm == my_lterm) & (g_end >= state.end)))
+    )
+    best = _lex_argmax(can_grant, [cand_term, g_lterm, g_end])
+    my_vote = jnp.where(i_cand, me, jnp.where(i_member, best, -1))
+    vote_cast = my_vote >= 0
+    new_voted_term = jnp.where(
+        vote_cast, jnp.maximum(state.voted_term, cand_term[my_vote]),
+        state.voted_term)
+    new_voted_for = jnp.where(vote_cast, my_vote, state.voted_for)
+
+    votes = lax.all_gather(my_vote, axis_name)              # [R]
+    got = (votes == me) & heard
+    win = (
+        i_cand
+        & (jnp.sum(got.astype(i32) * in_new) >= maj_new)
+        & jnp.where(transit > 0,
+                    jnp.sum(got.astype(i32) * in_old) >= maj_old, True)
+    )
+
+    # term adoption: everyone adopts the max term heard (incl. candidacies);
+    # a deposed leader steps down here — the fencing of server_to_follower
+    # (dare_server.c:2238).
+    my_term1 = jnp.where(i_cand, state.term + 1, state.term)
+    eff_term = jnp.where(is_cand, cand_term, g_term)
+    max_heard = jnp.max(jnp.where(heard, eff_term, I32_MIN))
+    new_term = jnp.maximum(my_term1, max_heard)
+
+    role = jnp.where(
+        win, int(Role.LEADER),
+        jnp.where(new_term > my_term1, int(Role.FOLLOWER),
+                  jnp.where(i_cand, int(Role.CANDIDATE), state.role)),
+    ).astype(i32)
+    became = win & (state.role != int(Role.LEADER))
+    i_lead = role == int(Role.LEADER)
+    leader_id = jnp.where(win, me,
+                          jnp.where(new_term > state.term, -1,
+                                    state.leader_id)).astype(i32)
+
+    # ------------------------------------------------------------------
+    # Phase C — leader append: NOOP on election (dare_server.c:1487),
+    # then the client batch (get_tailq_message → log_append_entry,
+    # dare_ibv_ud.c:780-790).
+    # ------------------------------------------------------------------
+    noop_data = jnp.zeros((1, cfg.slot_words), i32)
+    noop_meta = jnp.zeros((1, META_W), i32).at[0, M_TYPE].set(
+        int(EntryType.NOOP))
+    log1, end1 = append_batch(
+        state.log, state.end, state.head, noop_data, noop_meta,
+        jnp.where(became, 1, 0).astype(i32), new_term)
+    log2, end2 = append_batch(
+        log1, end1, state.head, inp.batch_data, inp.batch_meta,
+        jnp.where(i_lead, inp.batch_count, 0).astype(i32), new_term)
+
+    # ------------------------------------------------------------------
+    # Phase D — leader fan-out. Window floored at the minimum reachable
+    # member end (so laggards within W catch up — beyond W they need
+    # snapshot recovery, the analog of force_log_pruning eviction,
+    # dare_server.c:2069) and at the leader's own head (pruned entries
+    # are gone).
+    # ------------------------------------------------------------------
+    others = heard & (in_new > 0) & (jnp.arange(R) != me)
+    min_end = jnp.min(jnp.where(others, g_end, I32_MAX))
+    wstart = jnp.clip(min_end, end2 - W, end2)
+    wstart = jnp.maximum(jnp.maximum(wstart, state.head), 0)
+    wcount = jnp.clip(end2 - wstart, 0, W)
+    wdata, wmeta = extract_window(log2, wstart, W)
+    prev_term = jnp.where(
+        wstart > 0, log2.meta[slot_of(wstart - 1, cfg.n_slots), M_TERM], 0)
+
+    # pruning input: min apply over reachable members (leader-only use)
+    min_apply = jnp.min(jnp.where(heard & (in_new > 0), g_apply, I32_MAX))
+
+    msg_scal = jnp.zeros((S_N,), i32)
+    msg_scal = msg_scal.at[S_VALID].set(1)
+    msg_scal = msg_scal.at[S_WSTART].set(wstart)
+    msg_scal = msg_scal.at[S_WCOUNT].set(wcount)
+    msg_scal = msg_scal.at[S_TERM].set(new_term)
+    msg_scal = msg_scal.at[S_PREV].set(prev_term)
+    msg_scal = msg_scal.at[S_COMMIT].set(state.commit)
+    msg_scal = msg_scal.at[S_HEAD].set(state.head)
+
+    contrib = jnp.where(i_lead, 1, 0)
+    gw_data = lax.all_gather(wdata * contrib, axis_name)    # [R, W, sw]
+    gw_meta = lax.all_gather(wmeta * contrib, axis_name)    # [R, W, MW]
+    gw_scal = lax.all_gather(msg_scal * contrib, axis_name)  # [R, S_N]
+
+    # dominant leader: the highest-term valid claim this replica can hear
+    claim = heard & (gw_scal[:, S_VALID] > 0)
+    dom = _lex_argmax(claim, [gw_scal[:, S_TERM]])
+    has_msg = dom >= 0
+    dsafe = jnp.maximum(dom, 0)
+    m_scal = gw_scal[dsafe]
+    m_term = m_scal[S_TERM]
+
+    # ------------------------------------------------------------------
+    # Phase E — absorb (uniform; the leader absorbs its own window as a
+    # no-op). Term gate = fencing; prev-term check = AppendEntries
+    # consistency; backoff on mismatch = nextIndex rewind, expressed as
+    # data (our advertised end drops, so the next window reaches lower).
+    # ------------------------------------------------------------------
+    use = has_msg & (m_scal[S_VALID] > 0) & (m_term >= new_term)
+    new_term2 = jnp.where(use, jnp.maximum(new_term, m_term), new_term)
+    role2 = jnp.where(
+        use & ((m_term > new_term) | (dom != me)),
+        jnp.where(i_lead & (dom == me), role, int(Role.FOLLOWER)),
+        role).astype(i32)
+    leader_id2 = jnp.where(use, dom, leader_id)
+    i_lead2 = role2 == int(Role.LEADER)
+
+    m_wstart, m_wcount = m_scal[S_WSTART], m_scal[S_WCOUNT]
+    gap = m_wstart > end2
+    local_prev = jnp.where(
+        m_wstart > 0,
+        log2.meta[slot_of(m_wstart - 1, cfg.n_slots), M_TERM], 0)
+    prev_ok = (m_wstart == 0) | (local_prev == m_scal[S_PREV])
+    can_absorb = use & ~gap & prev_ok
+
+    log3, end3 = absorb_window(
+        log2, end2, gw_data[dsafe], gw_meta[dsafe], m_wstart,
+        jnp.where(can_absorb, m_wcount, 0))
+    # backoff: advertised end rewinds to just before the mismatch (never
+    # below commit — committed entries cannot conflict)
+    end3 = jnp.where(use & ~gap & ~prev_ok,
+                     jnp.maximum(m_wstart - 1, state.commit), end3)
+
+    # follower commit/head riding the message (lazy, one step behind the
+    # leader's scan — matching the reference's lazy commit push)
+    commit1 = jnp.where(
+        can_absorb & ~i_lead2,
+        jnp.maximum(state.commit, jnp.minimum(m_scal[S_COMMIT], end3)),
+        state.commit)
+    head1 = jnp.where(
+        can_absorb,
+        jnp.maximum(state.head, jnp.minimum(m_scal[S_HEAD], commit1)),
+        state.head)
+
+    # ------------------------------------------------------------------
+    # Phase F — ACK + quorum commit. The ack is the *verified match
+    # offset* (everything ≤ the absorbed window end matches the leader's
+    # log), gathered from all replicas — the analog of followers RDMA-
+    # writing reply[] bytes into the leader's entries. The commit scan
+    # itself is ops/quorum.commit_scan (Pallas on TPU).
+    # ------------------------------------------------------------------
+    my_ack = jnp.where(can_absorb, m_wstart + m_wcount, 0).astype(i32)
+    ack_pair = jnp.stack([my_ack, jnp.where(can_absorb, dom, -1)])
+    g_acks = lax.all_gather(ack_pair, axis_name)            # [R, 2]
+    acks_for_me = jnp.where(heard & (g_acks[:, 1] == me), g_acks[:, 0], 0)
+    acks_pad = jnp.zeros((R_PAD,), i32).at[:R].set(acks_for_me)
+
+    terms_win = log3.meta[
+        slot_of(state.commit + jnp.arange(W, dtype=i32), cfg.n_slots), M_TERM]
+    scanned = commit_scan(
+        acks_pad, state.commit, new_term2, end3, terms_win,
+        state.bitmask_old, state.bitmask_new, transit, maj_old, maj_new,
+        use_pallas=use_pallas, interpret=interpret)
+    commit2 = jnp.where(i_lead2, jnp.maximum(state.commit, scanned), commit1)
+
+    # ------------------------------------------------------------------
+    # Phase G — apply echo, pruning, CONFIG application.
+    # ------------------------------------------------------------------
+    apply2 = jnp.clip(jnp.maximum(state.apply, inp.apply_done),
+                      head1, commit2)
+    # Pruning is lazy and pressure-gated, like the reference: the periodic
+    # pruner only trims what every reachable member has applied
+    # (log_pruning P1/P2/P3 invariants, dare_server.c:1996-2067), and only
+    # once the ring is 3/4 full (force_log_pruning, :2069-2122) — so a
+    # transiently-partitioned laggard can still catch up from the log;
+    # one pruned past must snapshot-recover (host path), which is exactly
+    # the reference's straggler-eviction semantics.
+    pressure = (end3 - head1) > (3 * cfg.n_slots) // 4
+    head2 = jnp.where(
+        i_lead2 & pressure,
+        jnp.clip(jnp.maximum(head1, min_apply), head1, apply2),
+        head1)
+
+    # CONFIG entries take effect as soon as they are in the log (the
+    # reference's poll_config_entries, dare_server.c:2133-2187): scan the
+    # last W entries for the newest CONFIG with a fresher epoch.
+    scan_g = end3 - 1 - jnp.arange(W, dtype=i32)            # newest first
+    scan_valid = scan_g >= jnp.maximum(head2, end3 - W)
+    scan_slots = slot_of(jnp.maximum(scan_g, 0), cfg.n_slots)
+    is_config = scan_valid & (
+        log3.meta[scan_slots, M_TYPE] == int(EntryType.CONFIG))
+    cfg_pos = _lex_argmax(is_config, [scan_g])
+    cfg_slot = scan_slots[jnp.maximum(cfg_pos, 0)]
+    cfg_words = log3.data[cfg_slot]                         # payload
+    cfg_epoch = cfg_words[3]
+    take_cfg = (cfg_pos >= 0) & (cfg_epoch > state.epoch)
+    bm_old2 = jnp.where(take_cfg, cfg_words[0].astype(jnp.uint32),
+                        state.bitmask_old)
+    bm_new2 = jnp.where(take_cfg, cfg_words[1].astype(jnp.uint32),
+                        state.bitmask_new)
+    cid2 = jnp.where(take_cfg, cfg_words[2], state.cid_state)
+    epoch2 = jnp.where(take_cfg, cfg_epoch, state.epoch)
+
+    new_state = ReplicaState(
+        log=log3, term=new_term2, role=role2, leader_id=leader_id2,
+        voted_term=new_voted_term, voted_for=new_voted_for,
+        head=head2, apply=apply2, commit=commit2, end=end3,
+        cid_state=cid2, bitmask_old=bm_old2, bitmask_new=bm_new2,
+        epoch=epoch2,
+    )
+    out = StepOutput(
+        term=new_term2, role=role2, leader_id=leader_id2,
+        head=head2, apply=apply2, commit=commit2, end=end3,
+        hb_seen=(has_msg & use).astype(i32),
+        became_leader=became.astype(i32),
+        acked=can_absorb.astype(i32),
+        accepted=(end2 - end1).astype(i32),
+    )
+    return new_state, out
+
+
+def fetch_window(log: Log, start: jax.Array, *, window_slots: int):
+    """Host helper: gather ``window_slots`` entries beginning at ``start`` —
+    used by the driver to read newly committed payloads for replay/persist
+    (the analog of apply_committed_entries walking the log,
+    ``dare_server.c:1815-1974``)."""
+    return extract_window(log, start, window_slots)

@@ -1,0 +1,5 @@
+from rdma_paxos_tpu.ops.quorum import (  # noqa: F401
+    commit_scan,
+    commit_scan_ref,
+    commit_scan_pallas,
+)

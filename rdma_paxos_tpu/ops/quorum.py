@@ -1,0 +1,139 @@
+"""Quorum commit scan — the hot op of the consensus core.
+
+Reference: on every iteration of the replication loop the DARE leader decides
+commit by scanning entries in ``(commit, end]`` and counting per-entry ACK
+bytes that followers RDMA-wrote into the entry's ``reply[]`` array; an entry
+is committed iff the count reaches a majority, and during membership
+transitions iff it reaches *both* majorities (``dare_ibv_rc.c:1725-1758``,
+dual-quorum ``:2799-2957``; ``wait_for_majority`` ``:2768-2964``).
+
+TPU-native formulation: followers acknowledge by advertising their ``end``
+offset (an ``all_gather``), so the per-entry ACK bitmap is implicit:
+``ack[j, r] = (end_r > commit + j)``. The scan materializes that bitmap as a
+``[W, R_PAD]`` tile in VMEM, popcounts each row under the member bitmask(s),
+takes the contiguous committed prefix, and applies the Raft current-term
+guard (a leader only commits entries of its own term; earlier-term entries
+commit transitively — the reason the reference leader appends a blank NOOP
+entry on election, ``dare_server.c:1403-1491``). The result is a **monotone**
+commit-index advance.
+
+Two interchangeable implementations:
+
+* :func:`commit_scan_ref` — pure ``jax.numpy``; runs anywhere, used as the
+  test oracle and the CPU-simulation path.
+* :func:`commit_scan_pallas` — Pallas TPU kernel; one VMEM tile, VPU-only.
+
+Both are pure element-wise/reduction code on a ``[W, R_PAD]`` tile, so XLA
+also fuses the reference version well; the kernel exists to keep the scan in
+a single VMEM-resident pass and as the seed for fusing the whole
+ack-aggregate + commit-advance stage.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+R_PAD = 128   # lane-width padding of the replica axis (MAX_SERVER_COUNT=13)
+
+
+def _scan_math(ends, commit, my_term, my_end, terms_win, bm_old, bm_new,
+               transit, maj_old, maj_new, W):
+    """Shared scan body: ends [R_PAD] i32 (non-members already zeroed) ->
+    new commit (scalar i32, >= commit)."""
+    j = jax.lax.broadcasted_iota(jnp.int32, (W, R_PAD), 0)    # entry row
+    r = jax.lax.broadcasted_iota(jnp.int32, (W, R_PAD), 1)    # replica col
+
+    in_old = jnp.bitwise_and(
+        jnp.right_shift(bm_old, r.astype(jnp.uint32)), 1).astype(jnp.int32)
+    in_new = jnp.bitwise_and(
+        jnp.right_shift(bm_new, r.astype(jnp.uint32)), 1).astype(jnp.int32)
+
+    ack = (ends[None, :] > commit + j).astype(jnp.int32)      # [W, R_PAD]
+    cnt_old = jnp.sum(ack * in_old, axis=1)                   # [W]
+    cnt_new = jnp.sum(ack * in_new, axis=1)
+
+    jcol = jnp.arange(W, dtype=jnp.int32)
+    ok = (cnt_new >= maj_new) & (commit + jcol < my_end)
+    ok = ok & jnp.where(transit > 0, cnt_old >= maj_old, True)
+
+    # contiguous committed prefix length
+    prefix = jnp.where(jnp.all(ok), W, jnp.argmin(ok).astype(jnp.int32))
+
+    # Raft term guard: commit only up to the last current-term entry in the
+    # prefix (entries of older terms commit transitively below it).
+    eligible = (jcol < prefix) & (terms_win == my_term)
+    lastj = jnp.max(jnp.where(eligible, jcol, -1))
+    return jnp.where(lastj >= 0, commit + lastj + 1, commit).astype(jnp.int32)
+
+
+def commit_scan_ref(
+    ends: jax.Array,        # [R_PAD] i32 — gathered end offsets, 0 for
+                            #   non-members / unreachable replicas
+    commit: jax.Array,      # scalar i32 — current commit index
+    my_term: jax.Array,     # scalar i32 — leader's term
+    my_end: jax.Array,      # scalar i32 — leader's end
+    terms_win: jax.Array,   # [W] i32 — terms of entries commit .. commit+W-1
+    bitmask_old: jax.Array,  # scalar u32
+    bitmask_new: jax.Array,  # scalar u32
+    transit: jax.Array,     # scalar i32 — 1 if joint consensus active
+    maj_old: jax.Array,     # scalar i32
+    maj_new: jax.Array,     # scalar i32
+) -> jax.Array:
+    W = terms_win.shape[0]
+    return _scan_math(ends, commit, my_term, my_end, terms_win,
+                      bitmask_old, bitmask_new, transit, maj_old, maj_new, W)
+
+
+def _kernel(scal_ref, ends_ref, terms_ref, out_ref):
+    W = terms_ref.shape[1]
+    out_ref[0] = _scan_math(
+        ends=ends_ref[0, :],
+        commit=scal_ref[0],
+        my_term=scal_ref[1],
+        my_end=scal_ref[2],
+        terms_win=terms_ref[0, :],
+        bm_old=scal_ref[3].astype(jnp.uint32),
+        bm_new=scal_ref[4].astype(jnp.uint32),
+        transit=scal_ref[5],
+        maj_old=scal_ref[6],
+        maj_new=scal_ref[7],
+        W=W,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def commit_scan_pallas(ends, commit, my_term, my_end, terms_win,
+                       bitmask_old, bitmask_new, transit, maj_old, maj_new,
+                       *, interpret: bool = False) -> jax.Array:
+    """Pallas TPU version of :func:`commit_scan_ref` (same signature)."""
+    W = terms_win.shape[0]
+    scal = jnp.stack([
+        commit.astype(jnp.int32), my_term.astype(jnp.int32),
+        my_end.astype(jnp.int32), bitmask_old.astype(jnp.int32),
+        bitmask_new.astype(jnp.int32), transit.astype(jnp.int32),
+        maj_old.astype(jnp.int32), maj_new.astype(jnp.int32),
+    ])
+    out = pl.pallas_call(
+        _kernel,
+        out_shape=jax.ShapeDtypeStruct((1,), jnp.int32),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
+        interpret=interpret,
+    )(scal, ends.reshape(1, R_PAD), terms_win.reshape(1, W))
+    return out[0]
+
+
+def commit_scan(*args, use_pallas: bool = False, interpret: bool = False):
+    """Dispatcher: Pallas on TPU, jnp elsewhere (same semantics)."""
+    if use_pallas:
+        return commit_scan_pallas(*args, interpret=interpret)
+    return commit_scan_ref(*args)

@@ -1,0 +1,7 @@
+from rdma_paxos_tpu.parallel.mesh import (  # noqa: F401
+    REPLICA_AXIS,
+    make_replica_mesh,
+    build_spmd_step,
+    build_sim_step,
+    stack_states,
+)

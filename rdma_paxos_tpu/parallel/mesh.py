@@ -1,0 +1,96 @@
+"""Replica mesh + the two execution modes of the protocol step.
+
+The reference's distribution fabric is one RC QP pair per peer over
+InfiniBand (``src/dare/dare_ibv_rc.c``). The TPU equivalent is a 1-D
+``jax.sharding.Mesh`` over the ``replica`` axis — one consensus replica per
+chip — with the protocol step compiled via ``shard_map`` so XLA lowers the
+gathers onto ICI.
+
+Because the step is written against an *axis name* (``lax.axis_index`` /
+``lax.all_gather``), the identical protocol code also runs under
+``jax.vmap(..., axis_name=REPLICA_AXIS)``: N replicas simulated on a single
+chip (or CPU) with real collective semantics. That is the deterministic
+multi-replica test harness the reference never had (SURVEY.md §4) and the
+single-chip benchmarking mode.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from rdma_paxos_tpu.config import LogConfig
+from rdma_paxos_tpu.consensus.state import ReplicaState, make_replica_state
+from rdma_paxos_tpu.consensus.step import StepInput, replica_step
+
+REPLICA_AXIS = "replica"
+
+
+def make_replica_mesh(n_replicas: int,
+                      devices: Optional[Sequence] = None) -> Mesh:
+    """1-D mesh with one consensus replica per device."""
+    devs = list(jax.devices() if devices is None else devices)[:n_replicas]
+    if len(devs) < n_replicas:
+        raise ValueError(
+            f"need {n_replicas} devices, have {len(devs)}")
+    import numpy as np
+    return Mesh(np.array(devs), (REPLICA_AXIS,))
+
+
+def stack_states(cfg: LogConfig, n_replicas: int, group_size: int
+                 ) -> ReplicaState:
+    """Batched initial state: every leaf gains a leading replica axis."""
+    one = make_replica_state(cfg, group_size)
+    return jax.tree.map(
+        lambda x: jnp.broadcast_to(x, (n_replicas,) + x.shape), one)
+
+
+def _squeeze(tree):
+    return jax.tree.map(lambda x: x[0], tree)
+
+
+def _unsqueeze(tree):
+    return jax.tree.map(lambda x: x[None], tree)
+
+
+def build_spmd_step(cfg: LogConfig, n_replicas: int, mesh: Mesh, *,
+                    use_pallas: bool = False, interpret: bool = False,
+                    donate: bool = True):
+    """Compile the protocol step over a real device mesh.
+
+    Takes/returns *batched* pytrees (leading ``replica`` axis, sharded one
+    row per device). State buffers are donated so the log arrays update
+    in-place on device across steps — the analog of the reference's log
+    living pinned in registered MRs (``rc_memory_reg``,
+    ``dare_ibv_rc.c:240-276``).
+    """
+    core = functools.partial(
+        replica_step, cfg=cfg, n_replicas=n_replicas,
+        axis_name=REPLICA_AXIS, use_pallas=use_pallas, interpret=interpret)
+
+    def per_device(state_b, inp_b):
+        st, out = core(_squeeze(state_b), _squeeze(inp_b))
+        return _unsqueeze(st), _unsqueeze(out)
+
+    mapped = jax.shard_map(
+        per_device, mesh=mesh,
+        in_specs=(P(REPLICA_AXIS), P(REPLICA_AXIS)),
+        out_specs=(P(REPLICA_AXIS), P(REPLICA_AXIS)),
+        check_vma=False)
+    return jax.jit(mapped, donate_argnums=(0,) if donate else ())
+
+
+def build_sim_step(cfg: LogConfig, n_replicas: int, *,
+                   use_pallas: bool = False, interpret: bool = False,
+                   donate: bool = True):
+    """Compile the protocol step as an N-replica simulation on one device
+    (``vmap`` with a named axis — identical collective semantics)."""
+    core = functools.partial(
+        replica_step, cfg=cfg, n_replicas=n_replicas,
+        axis_name=REPLICA_AXIS, use_pallas=use_pallas, interpret=interpret)
+    mapped = jax.vmap(core, in_axes=(0, 0), axis_name=REPLICA_AXIS)
+    return jax.jit(mapped, donate_argnums=(0,) if donate else ())

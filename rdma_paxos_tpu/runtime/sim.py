@@ -1,0 +1,192 @@
+"""Deterministic in-process multi-replica cluster — the test/bench harness.
+
+The reference validates only end-to-end on a real IB cluster (SURVEY.md §4);
+this harness runs the full protocol (election, replication, commit, pruning,
+reconfig, partitions) deterministically on one host: N replicas are either N
+rows of a ``vmap``-simulated axis (``mode="sim"``, any single device) or one
+per device of a real mesh (``mode="spmd"``, shard_map).
+
+Partitions/crashes are expressed through per-replica ``peer_mask`` rows —
+the analog of ``reconf_bench.sh`` killing processes, but reproducible.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from rdma_paxos_tpu.config import LogConfig
+from rdma_paxos_tpu.consensus.log import (
+    EntryType, M_CONN, M_LEN, M_REQID, M_TYPE, META_W)
+from rdma_paxos_tpu.consensus.state import Role
+from rdma_paxos_tpu.consensus.step import StepInput, fetch_window
+from rdma_paxos_tpu.parallel.mesh import (
+    build_sim_step, build_spmd_step, make_replica_mesh, stack_states)
+from rdma_paxos_tpu.utils.codec import bytes_to_words
+
+
+class SimCluster:
+    """N-replica protocol simulation with host-side bookkeeping."""
+
+    # compiled steps are shared across clusters (same static config ⇒ same
+    # XLA program); without this every cluster re-traces the protocol
+    _STEP_CACHE: Dict[tuple, object] = {}
+
+    def __init__(self, cfg: LogConfig, n_replicas: int,
+                 group_size: Optional[int] = None, *, mode: str = "sim",
+                 use_pallas: bool = False, interpret: bool = False):
+        self.cfg = cfg
+        self.R = n_replicas
+        self.group_size = group_size or n_replicas
+        self.state = stack_states(cfg, n_replicas, self.group_size)
+        key = (cfg, n_replicas, mode, use_pallas, interpret)
+        cached = self._STEP_CACHE.get(key)
+        if mode == "spmd":
+            if cached is None:
+                mesh = make_replica_mesh(n_replicas)
+                cached = (build_spmd_step(cfg, n_replicas, mesh,
+                                          use_pallas=use_pallas,
+                                          interpret=interpret), mesh)
+                self._STEP_CACHE[key] = cached
+            self._step, self.mesh = cached
+            self.state = jax.device_put(
+                self.state,
+                jax.sharding.NamedSharding(
+                    self.mesh, jax.sharding.PartitionSpec("replica")))
+        else:
+            if cached is None:
+                cached = (build_sim_step(cfg, n_replicas,
+                                         use_pallas=use_pallas,
+                                         interpret=interpret), None)
+                self._STEP_CACHE[key] = cached
+            self._step = cached[0]
+        self._fetch = jax.jit(
+            lambda log, start: fetch_window(log, start,
+                                            window_slots=cfg.window_slots))
+        # host bookkeeping
+        self.applied = np.zeros(n_replicas, np.int64)   # host apply cursor
+        self.peer_mask = np.ones((n_replicas, n_replicas), np.int32)
+        self.pending: List[List[Tuple[int, int, int, bytes]]] = [
+            [] for _ in range(n_replicas)]
+        self._inflight: List[List[Tuple[int, int, int, bytes]]] = [
+            [] for _ in range(n_replicas)]
+        self.last: Optional[Dict[str, np.ndarray]] = None
+        self.replayed: List[List[Tuple[int, int, bytes]]] = [
+            [] for _ in range(n_replicas)]  # (type, conn, payload) per replica
+
+    # ---------------- client-side API ----------------
+
+    def submit(self, replica: int, payload: bytes,
+               etype: EntryType = EntryType.SEND, conn: int = 1,
+               req_id: int = 0) -> None:
+        """Queue a client entry for the next step on `replica` (it only
+        enters the log if that replica is leader — proxy semantics)."""
+        self.pending[replica].append((int(etype), conn, req_id, payload))
+
+    def partition(self, groups: Sequence[Sequence[int]]) -> None:
+        """Split the cluster: replicas hear only same-group peers."""
+        self.peer_mask[:] = 0
+        for g in groups:
+            for i in g:
+                for j in g:
+                    self.peer_mask[i, j] = 1
+        np.fill_diagonal(self.peer_mask, 1)
+
+    def heal(self) -> None:
+        self.peer_mask[:] = 1
+
+    # ---------------- stepping ----------------
+
+    def _build_inputs(self, timeouts: Sequence[int]) -> StepInput:
+        cfg, R = self.cfg, self.R
+        B = cfg.batch_slots
+        data = np.zeros((R, B, cfg.slot_words), np.int32)
+        meta = np.zeros((R, B, META_W), np.int32)
+        count = np.zeros((R,), np.int32)
+        for r in range(R):
+            take = self.pending[r][:B]
+            self.pending[r] = self.pending[r][B:]
+            self._inflight[r] = take
+            for i, (t, conn, req, payload) in enumerate(take):
+                data[r, i] = bytes_to_words(payload, cfg.slot_words)
+                meta[r, i, M_TYPE] = t
+                meta[r, i, M_CONN] = conn
+                meta[r, i, M_REQID] = req
+                meta[r, i, M_LEN] = len(payload)
+            count[r] = len(take)
+        tmo = np.zeros((R,), np.int32)
+        for r in timeouts:
+            tmo[r] = 1
+        return StepInput(
+            batch_data=jnp.asarray(data),
+            batch_meta=jnp.asarray(meta),
+            batch_count=jnp.asarray(count),
+            timeout_fired=jnp.asarray(tmo),
+            peer_mask=jnp.asarray(self.peer_mask),
+            apply_done=jnp.asarray(self.applied.astype(np.int32)),
+        )
+
+    def step(self, timeouts: Sequence[int] = ()) -> Dict[str, np.ndarray]:
+        inp = self._build_inputs(timeouts)
+        self.state, out = self._step(self.state, inp)
+        res = {k: np.asarray(getattr(out, k))
+               for k in ("term", "role", "leader_id", "head", "apply",
+                         "commit", "end", "hb_seen", "became_leader",
+                         "acked", "accepted")}
+        # ring-full backpressure: entries the leader could not append are
+        # requeued in order (submissions to non-leaders are dropped by
+        # design — proxy submits on the leader only)
+        for r in range(self.R):
+            take = self._inflight[r]
+            self._inflight[r] = []
+            if take and res["role"][r] == int(Role.LEADER):
+                acc = int(res["accepted"][r])
+                if acc < len(take):
+                    self.pending[r] = take[acc:] + self.pending[r]
+        self._replay_committed(res)
+        self.last = res
+        return res
+
+    def _replay_committed(self, res) -> None:
+        """Host apply loop: fetch newly committed entries from the device
+        log and 'replay' them (tests record them; the real driver hands
+        them to the proxy) — apply_committed_entries analog
+        (dare_server.c:1815-1974)."""
+        W = self.cfg.window_slots
+        for r in range(self.R):
+            commit = int(res["commit"][r])
+            if self.applied[r] >= commit:
+                continue
+            log_r = jax.tree.map(lambda x, r=r: x[r], self.state.log)
+            while self.applied[r] < commit:
+                start = int(self.applied[r])
+                n = min(commit - start, W)
+                wd, wm = self._fetch(log_r, jnp.asarray(start, jnp.int32))
+                wd, wm = np.asarray(wd), np.asarray(wm)
+                for j in range(n):
+                    t = int(wm[j, M_TYPE])
+                    if t in (int(EntryType.CONNECT), int(EntryType.SEND),
+                             int(EntryType.CLOSE)):
+                        ln = int(wm[j, M_LEN])
+                        payload = wd[j].astype("<i4").tobytes()[:ln]
+                        self.replayed[r].append((t, int(wm[j, M_CONN]),
+                                                 payload))
+                self.applied[r] += n
+
+    # ---------------- inspection ----------------
+
+    def leader(self) -> int:
+        assert self.last is not None
+        ids = [r for r in range(self.R)
+               if self.last["role"][r] == int(Role.LEADER)]
+        return ids[0] if len(ids) == 1 else -1
+
+    def run_until_elected(self, candidate: int, max_steps: int = 5) -> int:
+        for _ in range(max_steps):
+            res = self.step(timeouts=[candidate])
+            if res["role"][candidate] == int(Role.LEADER):
+                return candidate
+        raise AssertionError("election did not converge")

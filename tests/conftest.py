@@ -1,0 +1,23 @@
+"""Test harness config: run everything on a virtual 8-device CPU mesh.
+
+The reference has NO automated tests (SURVEY.md §4) — validation was
+end-to-end on a real InfiniBand cluster. Here the whole protocol (election,
+replication, commit, pruning, reconfig, recovery) runs deterministically
+in-process: N replicas = N virtual CPU devices (shard_map path) or one
+vmapped axis (sim path).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8"
+)
+
+import jax  # noqa: E402
+
+# The environment's sitecustomize may register an accelerator plugin and
+# force jax_platforms; tests always run on the virtual CPU mesh.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_threefry_partitionable", True)

@@ -1,0 +1,111 @@
+"""Election edge cases — the vote-granting and counting rules of the
+reference (``dare_server.c:1264-1743``) under simultaneous candidacies,
+stale logs, and vote-durability constraints."""
+
+import numpy as np
+import pytest
+
+from rdma_paxos_tpu.config import LogConfig
+from rdma_paxos_tpu.consensus.state import Role
+from rdma_paxos_tpu.runtime.sim import SimCluster
+
+CFG = LogConfig(n_slots=64, slot_bytes=32, window_slots=16, batch_slots=8)
+
+
+def test_simultaneous_candidates_single_winner():
+    """Two candidates in the same step: voters all rank the same best
+    candidate (deterministic lexicographic pick), so exactly one wins —
+    no split-vote livelock."""
+    c = SimCluster(CFG, 3)
+    res = c.step(timeouts=[0, 1])
+    leaders = [r for r in range(3) if res["role"][r] == int(Role.LEADER)]
+    assert len(leaders) == 1
+    assert res["term"][leaders[0]] == 1
+
+
+def test_stale_log_candidate_loses():
+    """Vote refusal for out-of-date logs (dare_server.c:1596-1652): a
+    candidate missing committed entries cannot win."""
+    c = SimCluster(CFG, 3)
+    c.run_until_elected(0)
+    c.submit(0, b"x")
+    c.step()
+    c.step()
+    # replica 2 partitioned away, misses entries
+    c.partition([[0, 1], [2]])
+    c.submit(0, b"y")
+    c.step()
+    c.step()
+    # heal the network but replica 2 immediately stands for election
+    # with a stale log: 0 and 1 must refuse; 2 cannot win.
+    c.heal()
+    res = c.step(timeouts=[2])
+    assert res["role"][2] != int(Role.LEADER)
+    # (the failed candidacy bumped terms; a fresh election by an
+    # up-to-date replica succeeds)
+    res = c.step(timeouts=[1])
+    assert res["role"][1] == int(Role.LEADER)
+    # committed data survives the churn
+    res = c.step()
+    res = c.step()
+    assert [p for (_, _, p) in c.replayed[2]] == [b"x", b"y"]
+
+
+def test_leader_steps_down_on_higher_term():
+    c = SimCluster(CFG, 3)
+    c.run_until_elected(0)
+    c.step()
+    # partition: majority side elects a new leader at a higher term
+    c.partition([[0], [1, 2]])
+    c.step(timeouts=[1])
+    c.heal()
+    res = c.step()
+    assert res["role"][0] == int(Role.FOLLOWER)
+    assert res["leader_id"][0] == 1
+    assert len([r for r in range(3)
+                if res["role"][r] == int(Role.LEADER)]) == 1
+
+
+def test_transitional_config_election_uses_both_quorums():
+    """During joint consensus (CID_TRANSIT, dare_config.h:17-24) a winner
+    needs majorities of BOTH configs, and old-config members must still be
+    allowed to vote — regression test for the old-only-voter deadlock."""
+    import jax.numpy as jnp
+    import dataclasses
+    from rdma_paxos_tpu.consensus.state import ConfigState
+
+    c = SimCluster(CFG, 5)
+    # force a transitional config old={0,1,2} new={0,3,4} on every replica
+    c.state = dataclasses.replace(
+        c.state,
+        cid_state=jnp.full((5,), int(ConfigState.TRANSIT), jnp.int32),
+        bitmask_old=jnp.full((5,), 0b00111, jnp.uint32),
+        bitmask_new=jnp.full((5,), 0b11001, jnp.uint32),
+    )
+    # candidate 0 is in both configs: old-only members 1,2 must grant votes
+    res = c.step(timeouts=[0])
+    assert res["role"][0] == int(Role.LEADER)
+    # replica 3 is new-only; with old-members 1 and 2 partitioned away it
+    # cannot reach the old-config majority -> must NOT win
+    c2 = SimCluster(CFG, 5)
+    c2.state = dataclasses.replace(
+        c2.state,
+        cid_state=jnp.full((5,), int(ConfigState.TRANSIT), jnp.int32),
+        bitmask_old=jnp.full((5,), 0b00111, jnp.uint32),
+        bitmask_new=jnp.full((5,), 0b11001, jnp.uint32),
+    )
+    c2.partition([[0, 3, 4], [1], [2]])
+    res = c2.step(timeouts=[3])
+    assert res["role"][3] != int(Role.LEADER)
+
+
+def test_no_quorum_no_leader():
+    """A candidate in a minority partition cannot win (losing majority
+    means no leadership — the reference's suicide-on-lost-majority,
+    dare_server.c:1213-1217, is a host-layer policy on top of this)."""
+    c = SimCluster(CFG, 5)
+    c.partition([[0], [1], [2, 3, 4]])
+    res = c.step(timeouts=[0])
+    assert res["role"][0] != int(Role.LEADER)
+    res = c.step(timeouts=[2])
+    assert res["role"][2] == int(Role.LEADER)  # majority side elects fine

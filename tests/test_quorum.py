@@ -1,0 +1,101 @@
+"""Quorum commit-scan tests: jnp reference vs Pallas (interpret mode on CPU)
+against a hand-written NumPy oracle — covering the semantics of the
+reference's commit scan (``dare_ibv_rc.c:1725-1758``) incl. dual-quorum
+transitional configs (``:2799-2957``) and the current-term commit guard."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rdma_paxos_tpu.ops.quorum import R_PAD, commit_scan_pallas, commit_scan_ref
+
+W = 16
+
+
+def oracle(ends, commit, my_term, my_end, terms, bm_old, bm_new, transit,
+           maj_old, maj_new):
+    """Straight-line NumPy restatement of the committed-prefix rule."""
+    best = commit
+    for j in range(W):
+        g = commit + j
+        if g >= my_end:
+            break
+        cnt_new = sum(1 for r in range(R_PAD)
+                      if (bm_new >> r) & 1 and ends[r] > g)
+        cnt_old = sum(1 for r in range(R_PAD)
+                      if (bm_old >> r) & 1 and ends[r] > g)
+        if cnt_new < maj_new or (transit and cnt_old < maj_old):
+            break
+        if terms[j] == my_term:
+            best = g + 1
+    return best
+
+
+def run_all(ends_list, commit, my_term, my_end, terms, bm_old=0b111,
+            bm_new=0b111, transit=0, maj_old=2, maj_new=2):
+    ends = np.zeros(R_PAD, np.int32)
+    ends[:len(ends_list)] = ends_list
+    args = (jnp.asarray(ends), jnp.asarray(commit, jnp.int32),
+            jnp.asarray(my_term, jnp.int32), jnp.asarray(my_end, jnp.int32),
+            jnp.asarray(terms, jnp.int32), jnp.asarray(bm_old, jnp.uint32),
+            jnp.asarray(bm_new, jnp.uint32), jnp.asarray(transit, jnp.int32),
+            jnp.asarray(maj_old, jnp.int32), jnp.asarray(maj_new, jnp.int32))
+    ref = int(commit_scan_ref(*args))
+    pal = int(commit_scan_pallas(*args, interpret=True))
+    exp = oracle(ends, commit, my_term, my_end, list(terms), bm_old, bm_new,
+                 transit, maj_old, maj_new)
+    assert ref == pal == exp, (ref, pal, exp)
+    return ref
+
+
+def test_simple_majority_advance():
+    terms = [3] * W
+    assert run_all([5, 5, 2], 0, 3, 5, terms) == 5  # 2-of-3 acked 5
+
+
+def test_monotone_no_regress():
+    terms = [3] * W
+    assert run_all([0, 0, 0], 4, 3, 10, terms) == 4  # nobody acked: stays
+
+
+def test_minority_does_not_commit():
+    terms = [3] * W
+    assert run_all([7, 0, 0], 0, 3, 7, terms) == 0
+
+
+def test_capped_by_leader_end():
+    terms = [3] * W
+    assert run_all([9, 9, 9], 0, 3, 6, terms) == 6
+
+
+def test_term_guard_blocks_old_term_only_prefix():
+    """Entries of an older term never commit by counting alone — only
+    transitively below a current-term entry (why a fresh leader appends a
+    NOOP, dare_server.c:1403-1491)."""
+    terms = [2, 2, 2] + [0] * (W - 3)
+    assert run_all([3, 3, 3], 0, 5, 3, terms) == 0
+    terms = [2, 2, 5] + [0] * (W - 3)
+    assert run_all([3, 3, 3], 0, 5, 3, terms) == 3  # term-5 entry commits all
+
+
+def test_gap_in_acks_stops_scan():
+    terms = [3] * W
+    # majority acked 2, one acked 5 -> only 2 commit
+    assert run_all([5, 2, 2], 0, 3, 5, terms) == 2
+
+
+def test_dual_quorum_transitional():
+    """Joint consensus: both old and new majorities required."""
+    terms = [7] * W
+    # old = {0,1,2}, new = {0,3,4}; transit=1
+    # ends: 0 and 1 acked (old maj ok), but new has only replica 0 -> blocked
+    assert run_all([4, 4, 0, 0, 0], 0, 7, 4, terms, bm_old=0b00111,
+                   bm_new=0b11001, transit=1, maj_old=2, maj_new=2) == 0
+    # now replica 3 acked too -> both quorums satisfied
+    assert run_all([4, 4, 0, 4, 0], 0, 7, 4, terms, bm_old=0b00111,
+                   bm_new=0b11001, transit=1, maj_old=2, maj_new=2) == 4
+
+
+def test_nonzero_commit_start():
+    terms = [4] * W
+    assert run_all([8, 8, 3], 3, 4, 8, terms) == 8
