@@ -59,10 +59,12 @@ def _scan_math(ends, commit, my_term, my_end, terms_win, bm_old, bm_new,
 
     jcol = jnp.arange(W, dtype=jnp.int32)
     ok = (cnt_new >= maj_new) & (commit + jcol < my_end)
-    ok = ok & jnp.where(transit > 0, cnt_old >= maj_old, True)
+    # boolean algebra, not where-on-bool (Mosaic can't legalize i1 selects)
+    ok = ok & ((transit <= 0) | (cnt_old >= maj_old))
 
-    # contiguous committed prefix length
-    prefix = jnp.where(jnp.all(ok), W, jnp.argmin(ok).astype(jnp.int32))
+    # contiguous committed prefix length = first False position (plain min
+    # reduction — integer arg-reductions don't lower on the TPU VPU)
+    prefix = jnp.min(jnp.where(ok, W, jcol))
 
     # Raft term guard: commit only up to the last current-term entry in the
     # prefix (entries of older terms commit transitively below it).
@@ -91,45 +93,50 @@ def commit_scan_ref(
 
 def _kernel(scal_ref, ends_ref, terms_ref, out_ref):
     W = terms_ref.shape[1]
-    out_ref[0] = _scan_math(
+    result = _scan_math(
         ends=ends_ref[0, :],
-        commit=scal_ref[0],
-        my_term=scal_ref[1],
-        my_end=scal_ref[2],
+        commit=scal_ref[0, 0],
+        my_term=scal_ref[0, 1],
+        my_end=scal_ref[0, 2],
         terms_win=terms_ref[0, :],
-        bm_old=scal_ref[3].astype(jnp.uint32),
-        bm_new=scal_ref[4].astype(jnp.uint32),
-        transit=scal_ref[5],
-        maj_old=scal_ref[6],
-        maj_new=scal_ref[7],
+        bm_old=scal_ref[0, 3].astype(jnp.uint32),
+        bm_new=scal_ref[0, 4].astype(jnp.uint32),
+        transit=scal_ref[0, 5],
+        maj_old=scal_ref[0, 6],
+        maj_new=scal_ref[0, 7],
         W=W,
     )
+    # VPU stores are vector-shaped: broadcast the scalar across the row
+    out_ref[:, :] = jnp.broadcast_to(result, (1, out_ref.shape[1]))
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def commit_scan_pallas(ends, commit, my_term, my_end, terms_win,
                        bitmask_old, bitmask_new, transit, maj_old, maj_new,
                        *, interpret: bool = False) -> jax.Array:
-    """Pallas TPU version of :func:`commit_scan_ref` (same signature)."""
+    """Pallas TPU version of :func:`commit_scan_ref` (same signature).
+
+    All operands ride in VMEM as (1, lane)-shaped i32 rows — no SMEM
+    blocks — so the call stays batchable: under ``vmap`` (the single-chip
+    multi-replica simulation) the batch dim lifts into the Pallas grid.
+    """
     W = terms_win.shape[0]
-    scal = jnp.stack([
-        commit.astype(jnp.int32), my_term.astype(jnp.int32),
-        my_end.astype(jnp.int32), bitmask_old.astype(jnp.int32),
-        bitmask_new.astype(jnp.int32), transit.astype(jnp.int32),
-        maj_old.astype(jnp.int32), maj_new.astype(jnp.int32),
-    ])
+    scal = jnp.zeros((1, R_PAD), jnp.int32)
+    for i, v in enumerate([commit, my_term, my_end, bitmask_old,
+                           bitmask_new, transit, maj_old, maj_new]):
+        scal = scal.at[0, i].set(v.astype(jnp.int32))
     out = pl.pallas_call(
         _kernel,
-        out_shape=jax.ShapeDtypeStruct((1,), jnp.int32),
+        out_shape=jax.ShapeDtypeStruct((1, R_PAD), jnp.int32),
         in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
         interpret=interpret,
     )(scal, ends.reshape(1, R_PAD), terms_win.reshape(1, W))
-    return out[0]
+    return out[0, 0]
 
 
 def commit_scan(*args, use_pallas: bool = False, interpret: bool = False):
