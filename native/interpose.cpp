@@ -1,0 +1,216 @@
+// interpose — LD_PRELOAD syscall-interposition shim.
+//
+// Native-equivalent of the reference's spec_hooks.cpp: hooks
+// __libc_start_main (init before the app's main, :48-100), accept/accept4
+// (:102-141), read (:161-178) and close (:143-159), filtering sockets via
+// fstat S_IFSOCK (:113-116). Where the reference calls straight into the
+// in-process proxy (proxy_on_accept/read/close, rsm-interface.h:12-15),
+// this shim forwards each event over a Unix domain socket to the replica
+// driver daemon and blocks until the driver acknowledges — on the leader
+// the ack arrives only after the event is committed by the consensus core,
+// reproducing the reference's spin-until-committed-and-applied semantics
+// (proxy.c:160) without sharing an address space with JAX.
+//
+// Env:
+//   RP_PROXY_SOCK  — path of the driver's Unix socket. Unset => all hooks
+//                    pass through untouched (the app runs unreplicated).
+//
+// Wire format (little-endian):
+//   request : [u8 op][i32 fd][u32 len][len bytes]   op: 1=HELLO 2=CONNECT
+//                                                       3=SEND  4=CLOSE
+//   response: [i32 status]   >=0 ok / pass; <0 drop connection
+//
+// Build: make -C native  ->  interpose.so
+
+#include <cstdarg>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+
+#include <dlfcn.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <pthread.h>
+#include <stdlib.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+namespace {
+
+enum Op : uint8_t { OP_HELLO = 1, OP_CONNECT = 2, OP_SEND = 3, OP_CLOSE = 4 };
+
+using accept_fn = int (*)(int, struct sockaddr*, socklen_t*);
+using accept4_fn = int (*)(int, struct sockaddr*, socklen_t*, int);
+using read_fn = ssize_t (*)(int, void*, size_t);
+using close_fn = int (*)(int);
+using main_fn = int (*)(int, char**, char**);
+
+accept_fn real_accept;
+accept4_fn real_accept4;
+read_fn real_read;
+close_fn real_close;
+main_fn real_main;
+
+int proxy_fd = -1;                    // UDS to the driver daemon
+pthread_mutex_t proxy_mu = PTHREAD_MUTEX_INITIALIZER;
+constexpr int kMaxFd = 65536;
+unsigned char tracked[kMaxFd];        // fds that arrived through accept()
+
+void resolve() {
+  real_accept = (accept_fn)dlsym(RTLD_NEXT, "accept");
+  real_accept4 = (accept4_fn)dlsym(RTLD_NEXT, "accept4");
+  real_read = (read_fn)dlsym(RTLD_NEXT, "read");
+  real_close = (close_fn)dlsym(RTLD_NEXT, "close");
+}
+
+bool io_exact(int fd, void* buf, size_t n, bool writing) {
+  size_t done = 0;
+  while (done < n) {
+    ssize_t r = writing
+        ? write(fd, static_cast<char*>(buf) + done, n - done)
+        : real_read(fd, static_cast<char*>(buf) + done, n - done);
+    if (r < 0 && errno == EINTR) continue;  // signals during the commit
+                                            // wait must not kill the link
+    if (r <= 0) return false;
+    done += static_cast<size_t>(r);
+  }
+  return true;
+}
+
+// Send one event and wait for the driver's verdict. Thread-safe: the app
+// may serve connections from many threads (the reference serializes the
+// same way with the tailq spinlock, message.h:22).
+int32_t proxy_call(uint8_t op, int32_t fd, const void* data, uint32_t len) {
+  if (proxy_fd < 0) return 0;
+  pthread_mutex_lock(&proxy_mu);
+  uint8_t hdr[9];
+  hdr[0] = op;
+  memcpy(hdr + 1, &fd, 4);
+  memcpy(hdr + 5, &len, 4);
+  int32_t status = 0;
+  bool ok = io_exact(proxy_fd, hdr, sizeof hdr, true) &&
+            (len == 0 || io_exact(proxy_fd, const_cast<void*>(data), len,
+                                  true)) &&
+            io_exact(proxy_fd, &status, 4, false);
+  if (!ok) {  // driver died: stop interposing, let the app run bare
+    real_close(proxy_fd);
+    proxy_fd = -1;
+    status = 0;
+  }
+  pthread_mutex_unlock(&proxy_mu);
+  return status;
+}
+
+void rp_init() {
+  resolve();
+  const char* path = getenv("RP_PROXY_SOCK");
+  if (!path) return;
+  int fd = socket(AF_UNIX, SOCK_STREAM, 0);
+  if (fd < 0) return;
+  struct sockaddr_un addr;
+  memset(&addr, 0, sizeof addr);
+  addr.sun_family = AF_UNIX;
+  strncpy(addr.sun_path, path, sizeof(addr.sun_path) - 1);
+  if (connect(fd, reinterpret_cast<struct sockaddr*>(&addr),
+              sizeof addr) != 0) {
+    real_close(fd);
+    return;
+  }
+  proxy_fd = fd;
+  int32_t pid = static_cast<int32_t>(getpid());
+  proxy_call(OP_HELLO, pid, nullptr, 0);
+}
+
+bool is_socket(int fd) {
+  struct stat st;
+  return fstat(fd, &st) == 0 && S_ISSOCK(st.st_mode);
+}
+
+void on_accepted(int fd) {
+  if (fd >= 0 && fd < kMaxFd && is_socket(fd)) {
+    tracked[fd] = 1;
+    // CONNECT carries the peer's address so the driver can tell its own
+    // replay connections apart from real clients.
+    uint8_t info[6] = {0, 0, 0, 0, 0, 0};
+    struct sockaddr_in sa;
+    socklen_t sl = sizeof sa;
+    if (getpeername(fd, reinterpret_cast<struct sockaddr*>(&sa), &sl) == 0 &&
+        sa.sin_family == AF_INET) {
+      memcpy(info, &sa.sin_addr.s_addr, 4);
+      memcpy(info + 4, &sa.sin_port, 2);  // network byte order
+    }
+    if (proxy_call(OP_CONNECT, fd, info, 6) < 0) {
+      // driver refused the connection (e.g. replicated session on a
+      // deposed leader): sever it so the client reconnects elsewhere
+      tracked[fd] = 0;
+      shutdown(fd, SHUT_RDWR);
+    }
+  }
+}
+
+int wrapped_main(int argc, char** argv, char** envp) {
+  rp_init();
+  return real_main(argc, argv, envp);
+}
+
+}  // namespace
+
+extern "C" {
+
+int __libc_start_main(main_fn main, int argc, char** ubp_av,
+                      void (*init)(void), void (*fini)(void),
+                      void (*rtld_fini)(void), void* stack_end) {
+  real_main = main;
+  auto real = (int (*)(main_fn, int, char**, void (*)(void), void (*)(void),
+                       void (*)(void), void*))
+      dlsym(RTLD_NEXT, "__libc_start_main");
+  return real(wrapped_main, argc, ubp_av, init, fini, rtld_fini, stack_end);
+}
+
+int accept(int sockfd, struct sockaddr* addr, socklen_t* addrlen) {
+  if (!real_accept) resolve();
+  int fd = real_accept(sockfd, addr, addrlen);
+  if (proxy_fd >= 0) on_accepted(fd);
+  return fd;
+}
+
+int accept4(int sockfd, struct sockaddr* addr, socklen_t* addrlen,
+            int flags) {
+  if (!real_accept4) resolve();
+  int fd = real_accept4(sockfd, addr, addrlen, flags);
+  if (proxy_fd >= 0) on_accepted(fd);
+  return fd;
+}
+
+ssize_t read(int fd, void* buf, size_t count) {
+  if (!real_read) resolve();
+  ssize_t n = real_read(fd, buf, count);
+  // Replicate inbound client bytes before the app acts on them; the
+  // driver's ack means "committed by a quorum" on the leader. A negative
+  // status means the event could NOT be committed (e.g. leadership was
+  // lost mid-session): the bytes must never reach the app, so the
+  // connection is severed and the client retries against the new leader.
+  if (n > 0 && proxy_fd >= 0 && fd >= 0 && fd < kMaxFd && tracked[fd]) {
+    if (proxy_call(OP_SEND, fd, buf, static_cast<uint32_t>(n)) < 0) {
+      tracked[fd] = 0;
+      shutdown(fd, SHUT_RDWR);
+      errno = ECONNRESET;
+      return -1;
+    }
+  }
+  return n;
+}
+
+int close(int fd) {
+  if (!real_close) resolve();
+  if (proxy_fd >= 0 && fd >= 0 && fd < kMaxFd && tracked[fd]) {
+    tracked[fd] = 0;
+    proxy_call(OP_CLOSE, fd, nullptr, 0);
+  }
+  return real_close(fd);
+}
+
+}  // extern "C"

@@ -1,0 +1,168 @@
+// stablestore — append-only record store for replicated socket events.
+//
+// Native-equivalent of the reference's BerkeleyDB RECNO layer
+// (src/db/db-interface.c: initialize_db :21, store_record :65 with
+// DB_APPEND, dump_records/get_records_len :98-134): every committed client
+// event is persisted in arrival order; the whole store serializes into a
+// single buffer for joiner snapshot transfer and replays back on the other
+// side (proxy.c:306-339 stablestorage_load_records).
+//
+// Format: a single file of length-prefixed records:
+//   [u32 len][len bytes] ...
+// An in-memory offset index is rebuilt by scanning on open (truncated tail
+// records from a crash are discarded — they were un-synced and thus
+// un-acked). Exposed as a flat C API for ctypes.
+//
+// Build: make -C native   ->  libstablestore.so
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+#include <mutex>
+
+#include <fcntl.h>
+#include <unistd.h>
+#include <sys/stat.h>
+
+namespace {
+
+struct Store {
+  int fd = -1;
+  std::vector<uint64_t> offsets;  // file offset of each record's header
+  uint64_t end = 0;               // valid data end (scan watermark)
+  std::mutex mu;
+};
+
+bool read_exact(int fd, void* buf, size_t n, uint64_t off) {
+  size_t done = 0;
+  while (done < n) {
+    ssize_t r = pread(fd, static_cast<char*>(buf) + done, n - done,
+                      static_cast<off_t>(off + done));
+    if (r <= 0) return false;
+    done += static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_exact(int fd, const void* buf, size_t n) {
+  size_t done = 0;
+  while (done < n) {
+    ssize_t r = write(fd, static_cast<const char*>(buf) + done, n - done);
+    if (r < 0) return false;
+    done += static_cast<size_t>(r);
+  }
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Open (creating if absent) and index the store. Returns NULL on error.
+void* ss_open(const char* path) {
+  int fd = open(path, O_RDWR | O_CREAT, 0644);
+  if (fd < 0) return nullptr;
+  auto* s = new Store;
+  s->fd = fd;
+  struct stat st;
+  if (fstat(fd, &st) != 0) { delete s; close(fd); return nullptr; }
+  uint64_t size = static_cast<uint64_t>(st.st_size), off = 0;
+  while (off + 4 <= size) {
+    uint32_t len;
+    if (!read_exact(fd, &len, 4, off)) break;
+    if (off + 4 + len > size) break;  // torn tail record: drop
+    s->offsets.push_back(off);
+    off += 4 + len;
+  }
+  s->end = off;
+  if (off < size) {
+    if (ftruncate(fd, static_cast<off_t>(off)) != 0) { /* keep going */ }
+  }
+  lseek(fd, static_cast<off_t>(off), SEEK_SET);
+  return s;
+}
+
+// Append one record; returns its index, or -1 on error.
+int64_t ss_append(void* h, const void* buf, uint32_t len) {
+  auto* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
+  uint32_t l = len;
+  if (!write_exact(s->fd, &l, 4) || !write_exact(s->fd, buf, len)) {
+    // roll back a partial write so the file cursor and the offset index
+    // stay consistent — a later successful append must land at s->end
+    if (ftruncate(s->fd, static_cast<off_t>(s->end)) != 0) { /* best effort */ }
+    lseek(s->fd, static_cast<off_t>(s->end), SEEK_SET);
+    return -1;
+  }
+  s->offsets.push_back(s->end);
+  s->end += 4 + len;
+  return static_cast<int64_t>(s->offsets.size()) - 1;
+}
+
+int ss_sync(void* h) {
+  auto* s = static_cast<Store*>(h);
+  return fdatasync(s->fd) == 0 ? 0 : -1;
+}
+
+int64_t ss_count(void* h) {
+  auto* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
+  return static_cast<int64_t>(s->offsets.size());
+}
+
+// Read record idx into out (cap bytes). Returns record length (may exceed
+// cap, in which case only cap bytes were copied), or -1 if out of range.
+int64_t ss_read(void* h, uint64_t idx, void* out, uint32_t cap) {
+  auto* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
+  if (idx >= s->offsets.size()) return -1;
+  uint64_t off = s->offsets[idx];
+  uint32_t len;
+  if (!read_exact(s->fd, &len, 4, off)) return -1;
+  uint32_t n = len < cap ? len : cap;
+  if (n && !read_exact(s->fd, out, n, off + 4)) return -1;
+  return static_cast<int64_t>(len);
+}
+
+// Total bytes of a full dump (the snapshot payload for joiner recovery).
+int64_t ss_dump_len(void* h) {
+  auto* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
+  return static_cast<int64_t>(s->end);
+}
+
+// Serialize the whole store into out; returns bytes written or -1.
+int64_t ss_dump(void* h, void* out, uint64_t cap) {
+  auto* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
+  if (cap < s->end) return -1;
+  if (s->end && !read_exact(s->fd, out, s->end, 0)) return -1;
+  return static_cast<int64_t>(s->end);
+}
+
+// Append every record of a dump produced by ss_dump (joiner side).
+// Returns number of records loaded, or -1 on malformed input.
+int64_t ss_load(void* h, const void* buf, uint64_t len) {
+  const char* p = static_cast<const char*>(buf);
+  uint64_t off = 0;
+  int64_t n = 0;
+  while (off + 4 <= len) {
+    uint32_t l;
+    memcpy(&l, p + off, 4);
+    if (off + 4 + l > len) return -1;
+    if (ss_append(h, p + off + 4, l) < 0) return -1;
+    off += 4 + l;
+    n++;
+  }
+  return off == len ? n : -1;
+}
+
+void ss_close(void* h) {
+  auto* s = static_cast<Store*>(h);
+  close(s->fd);
+  delete s;
+}
+
+}  // extern "C"

@@ -1,0 +1,130 @@
+/* toyserver — a deliberately unmodified, plain-libc TCP key-value server.
+ *
+ * Plays the role of the reference's pristine Redis/memcached builds
+ * (apps/redis/mk): the e2e tests replicate it via LD_PRELOAD=interpose.so
+ * without it knowing. Protocol (newline-framed, one request per line):
+ *   SET <key> <value>\n  -> +OK\n
+ *   GET <key>\n          -> <value>\n or -\n
+ *   DEL <key>\n          -> +OK\n
+ *   COUNT\n              -> <n>\n
+ * Uses accept()/read()/write()/close() directly — the exact syscall
+ * surface the shim hooks. Single-threaded, poll-based, multiple clients.
+ */
+#include <arpa/inet.h>
+#include <errno.h>
+#include <netinet/in.h>
+#include <poll.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#define MAXKV 4096
+#define MAXC 64
+#define BUFSZ 65536
+
+static char keys[MAXKV][64], vals[MAXKV][256];
+static int nkv = 0;
+
+static const char* kv_get(const char* k) {
+  for (int i = 0; i < nkv; i++)
+    if (!strcmp(keys[i], k)) return vals[i];
+  return NULL;
+}
+static void kv_set(const char* k, const char* v) {
+  for (int i = 0; i < nkv; i++)
+    if (!strcmp(keys[i], k)) { snprintf(vals[i], 256, "%s", v); return; }
+  if (nkv < MAXKV) {
+    snprintf(keys[nkv], 64, "%s", k);
+    snprintf(vals[nkv], 256, "%s", v);
+    nkv++;
+  }
+}
+static void kv_del(const char* k) {
+  for (int i = 0; i < nkv; i++)
+    if (!strcmp(keys[i], k)) {
+      memmove(&keys[i], &keys[nkv - 1], 64);
+      memmove(&vals[i], &vals[nkv - 1], 256);
+      nkv--;
+      return;
+    }
+}
+
+struct conn { int fd; char buf[BUFSZ]; int len; };
+
+static void handle_line(int fd, char* line) {
+  char out[512], k[64], v[256];
+  if (sscanf(line, "SET %63s %255[^\n]", k, v) == 2) {
+    kv_set(k, v);
+    snprintf(out, sizeof out, "+OK\n");
+  } else if (sscanf(line, "GET %63s", k) == 1) {
+    const char* r = kv_get(k);
+    snprintf(out, sizeof out, "%s\n", r ? r : "-");
+  } else if (sscanf(line, "DEL %63s", k) == 1) {
+    kv_del(k);
+    snprintf(out, sizeof out, "+OK\n");
+  } else if (!strncmp(line, "COUNT", 5)) {
+    snprintf(out, sizeof out, "%d\n", nkv);
+  } else {
+    snprintf(out, sizeof out, "-ERR\n");
+  }
+  ssize_t w = write(fd, out, strlen(out));
+  (void)w;
+}
+
+int main(int argc, char** argv) {
+  int port = argc > 1 ? atoi(argv[1]) : 7000;
+  int ls = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(ls, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  struct sockaddr_in a = {0};
+  a.sin_family = AF_INET;
+  a.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  a.sin_port = htons((unsigned short)port);
+  if (bind(ls, (struct sockaddr*)&a, sizeof a) != 0) { perror("bind"); return 1; }
+  listen(ls, 64);
+  fprintf(stderr, "toyserver listening on %d\n", port);
+
+  struct conn cs[MAXC];
+  for (int i = 0; i < MAXC; i++) cs[i].fd = -1;
+
+  for (;;) {
+    struct pollfd pfds[MAXC + 1];
+    int idx[MAXC + 1], np = 0;
+    pfds[np].fd = ls; pfds[np].events = POLLIN; idx[np++] = -1;
+    for (int i = 0; i < MAXC; i++)
+      if (cs[i].fd >= 0) {
+        pfds[np].fd = cs[i].fd; pfds[np].events = POLLIN; idx[np++] = i;
+      }
+    if (poll(pfds, (nfds_t)np, -1) < 0) continue;
+    for (int p = 0; p < np; p++) {
+      if (!(pfds[p].revents & (POLLIN | POLLHUP | POLLERR))) continue;
+      if (idx[p] < 0) {
+        int fd = accept(ls, NULL, NULL);
+        if (fd < 0) continue;
+        int i;
+        for (i = 0; i < MAXC && cs[i].fd >= 0; i++) {}
+        if (i == MAXC) { close(fd); continue; }
+        cs[i].fd = fd; cs[i].len = 0;
+      } else {
+        struct conn* c = &cs[idx[p]];
+        ssize_t n = read(c->fd, c->buf + c->len,
+                         (size_t)(BUFSZ - c->len - 1));
+        if (n <= 0) { close(c->fd); c->fd = -1; continue; }
+        c->len += (int)n;
+        c->buf[c->len] = 0;
+        char* start = c->buf;
+        char* nl;
+        while ((nl = strchr(start, '\n'))) {
+          *nl = 0;
+          handle_line(c->fd, start);
+          start = nl + 1;
+        }
+        int rest = (int)(c->buf + c->len - start);
+        memmove(c->buf, start, (size_t)rest);
+        c->len = rest;
+      }
+    }
+  }
+}

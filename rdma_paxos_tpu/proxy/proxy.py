@@ -1,0 +1,217 @@
+"""Proxy: the RSM client + replay engine (reference ``src/proxy/proxy.c``).
+
+Leader side: every socket event the interposition shim reports (CONNECT /
+SEND / CLOSE) is tagged with a cluster-wide connection id
+(``node_id << 8 | counter`` — proxy.c:101-106), queued for the driver to
+batch into the consensus step, and the shim's blocking ack is released only
+once the entry is committed + applied (the spin at proxy.c:160, here a
+``threading.Event``).
+
+Follower side: committed events whose connection id originates at another
+node are replayed into the local unmodified app over loopback TCP
+(``do_action_connect/send/close``, proxy.c:373-439) — producing the
+identical byte stream the leader's app consumed.
+
+The shim ↔ driver wire protocol is defined in ``native/interpose.cpp``.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from rdma_paxos_tpu.consensus.log import EntryType
+
+OP_HELLO, OP_CONNECT, OP_SEND, OP_CLOSE = 1, 2, 3, 4
+
+_OP_TO_ETYPE = {
+    OP_CONNECT: EntryType.CONNECT,
+    OP_SEND: EntryType.SEND,
+    OP_CLOSE: EntryType.CLOSE,
+}
+
+
+@dataclass
+class PendingEvent:
+    """One shim event awaiting commit (the blocked app thread's handle)."""
+
+    etype: EntryType
+    conn_id: int
+    payload: bytes
+    done: threading.Event = field(default_factory=threading.Event)
+    status: int = 0
+
+    def release(self, status: int = 0) -> None:
+        self.status = status
+        self.done.set()
+
+
+class ProxyServer:
+    """Unix-socket server the interposed app connects to.
+
+    One thread per app link; events on a link are strictly serialized by
+    the shim's mutex, so the link thread reads an event, hands it to the
+    driver-provided ``on_event`` callback, waits for release if deferred,
+    and writes the status back.
+    """
+
+    def __init__(self, sock_path: str, node_id: int,
+                 on_event: Callable[[int, int, bytes],
+                                    Optional[PendingEvent]]):
+        self.sock_path = sock_path
+        self.node_id = node_id
+        self.on_event = on_event
+        self._conn_ctr = 0
+        self.conn_of_fd: Dict[Tuple[int, int], int] = {}  # (link, fd) -> id
+        if os.path.exists(sock_path):
+            os.unlink(sock_path)
+        self._srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._srv.bind(sock_path)
+        self._srv.listen(8)
+        self._links: List[socket.socket] = []
+        self._link_ctr = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        daemon=True)
+        self._thread.start()
+
+    def next_conn_id(self) -> int:
+        self._conn_ctr = (self._conn_ctr + 1) & 0xFFFFFF
+        return (self.node_id << 24) | self._conn_ctr
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                link, _ = self._srv.accept()
+            except OSError:
+                return
+            self._links.append(link)
+            lid = self._link_ctr
+            self._link_ctr += 1
+            threading.Thread(target=self._serve_link, args=(link, lid),
+                             daemon=True).start()
+
+    def _recv_exact(self, sock: socket.socket, n: int) -> Optional[bytes]:
+        buf = b""
+        while len(buf) < n:
+            chunk = sock.recv(n - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    def _serve_link(self, link: socket.socket, lid: int) -> None:
+        try:
+            while not self._stop.is_set():
+                hdr = self._recv_exact(link, 9)
+                if hdr is None:
+                    return
+                op, fd, ln = struct.unpack("<BiI", hdr)
+                payload = self._recv_exact(link, ln) if ln else b""
+                if payload is None:
+                    return
+                status = 0
+                if op == OP_HELLO:
+                    pass
+                elif op in _OP_TO_ETYPE:
+                    if op == OP_CONNECT:
+                        self.conn_of_fd[(lid, fd)] = self.next_conn_id()
+                    conn_id = self.conn_of_fd.get((lid, fd), 0)
+                    if op == OP_CLOSE:
+                        self.conn_of_fd.pop((lid, fd), None)
+                    # handler returns: None => pass through (0);
+                    # int => immediate status (<0 severs the connection);
+                    # PendingEvent => block until committed
+                    ev = self.on_event(int(_OP_TO_ETYPE[op]), conn_id,
+                                       payload)
+                    if isinstance(ev, PendingEvent):
+                        ev.done.wait()
+                        status = ev.status
+                    elif isinstance(ev, int):
+                        status = ev
+                link.sendall(struct.pack("<i", status))
+        except OSError:
+            pass
+        finally:
+            try:
+                link.close()
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        for l in self._links:
+            try:
+                l.close()
+            except OSError:
+                pass
+        if os.path.exists(self.sock_path):
+            os.unlink(self.sock_path)
+
+
+class ReplayEngine:
+    """Replays committed remote-origin events into the local app over
+    loopback TCP (the follower half of the reference proxy)."""
+
+    def __init__(self, app_host: str, app_port: int):
+        self.addr = (app_host, app_port)
+        self.conns: Dict[int, socket.socket] = {}
+        # local (ephemeral) ports of our replay sockets: the driver uses
+        # these to recognize its own replayed connections arriving back
+        # through the app's interposition shim
+        self.local_ports: set = set()
+
+    def _connect(self, conn_id: int) -> socket.socket:
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.connect(self.addr)
+        self.conns[conn_id] = s
+        self.local_ports.add(s.getsockname()[1])
+        return s
+
+    def apply(self, etype: int, conn_id: int, payload: bytes) -> None:
+        if etype == int(EntryType.CONNECT):
+            self._connect(conn_id)
+        elif etype == int(EntryType.SEND):
+            s = self.conns.get(conn_id)
+            if s is None:       # joined mid-stream: open lazily
+                s = self._connect(conn_id)
+            s.sendall(payload)
+        elif etype == int(EntryType.CLOSE):
+            s = self.conns.pop(conn_id, None)
+            if s is not None:
+                try:
+                    self.local_ports.discard(s.getsockname()[1])
+                    s.close()
+                except OSError:
+                    pass
+
+    def drain_responses(self) -> None:
+        """The local app writes responses to replayed connections; nobody
+        reads them (the reference's follower likewise discards app output
+        — only the leader's app talks to real clients). Drain so the app
+        never blocks on a full socket buffer."""
+        for s in self.conns.values():
+            s.setblocking(False)
+            try:
+                while s.recv(65536):
+                    pass
+            except (BlockingIOError, OSError):
+                pass
+            finally:
+                s.setblocking(True)
+
+    def close(self) -> None:
+        for s in self.conns.values():
+            try:
+                s.close()
+            except OSError:
+                pass
+        self.conns.clear()

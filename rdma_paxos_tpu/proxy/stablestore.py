@@ -1,0 +1,108 @@
+"""ctypes binding to the native append-only stable store
+(``native/stablestore.cpp`` — the BerkeleyDB RECNO analog of the reference's
+``src/db/db-interface.c``)."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libstablestore.so")
+
+_lib: Optional[ctypes.CDLL] = None
+
+
+def _load() -> ctypes.CDLL:
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_LIB_PATH):
+        subprocess.run(["make", "-C", _NATIVE_DIR, "libstablestore.so"],
+                       check=True, capture_output=True)
+    lib = ctypes.CDLL(_LIB_PATH)
+    lib.ss_open.restype = ctypes.c_void_p
+    lib.ss_open.argtypes = [ctypes.c_char_p]
+    lib.ss_append.restype = ctypes.c_int64
+    lib.ss_append.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                              ctypes.c_uint32]
+    lib.ss_sync.restype = ctypes.c_int
+    lib.ss_sync.argtypes = [ctypes.c_void_p]
+    lib.ss_count.restype = ctypes.c_int64
+    lib.ss_count.argtypes = [ctypes.c_void_p]
+    lib.ss_read.restype = ctypes.c_int64
+    lib.ss_read.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                            ctypes.c_char_p, ctypes.c_uint32]
+    lib.ss_dump_len.restype = ctypes.c_int64
+    lib.ss_dump_len.argtypes = [ctypes.c_void_p]
+    lib.ss_dump.restype = ctypes.c_int64
+    lib.ss_dump.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                            ctypes.c_uint64]
+    lib.ss_load.restype = ctypes.c_int64
+    lib.ss_load.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                            ctypes.c_uint64]
+    lib.ss_close.restype = None
+    lib.ss_close.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return lib
+
+
+class StableStore:
+    """Append-only record store; every committed socket event is persisted
+    in log order (store_record analog, db-interface.c:65-96), and the whole
+    store serializes into one buffer for joiner snapshot transfer
+    (dump_records :98-134)."""
+
+    def __init__(self, path: str):
+        self._lib = _load()
+        self._h = self._lib.ss_open(path.encode())
+        if not self._h:
+            raise OSError(f"cannot open stable store at {path}")
+
+    def append(self, record: bytes) -> int:
+        idx = self._lib.ss_append(self._h, record, len(record))
+        if idx < 0:
+            raise OSError("stable store append failed")
+        return idx
+
+    def sync(self) -> None:
+        if self._lib.ss_sync(self._h) != 0:
+            raise OSError("fdatasync failed")
+
+    def __len__(self) -> int:
+        return int(self._lib.ss_count(self._h))
+
+    def read(self, idx: int, cap: int = 1 << 20) -> bytes:
+        buf = ctypes.create_string_buffer(cap)
+        n = self._lib.ss_read(self._h, idx, buf, cap)
+        if n < 0:
+            raise IndexError(idx)
+        return buf.raw[:min(n, cap)]
+
+    def dump(self) -> bytes:
+        n = self._lib.ss_dump_len(self._h)
+        buf = ctypes.create_string_buffer(max(int(n), 1))
+        w = self._lib.ss_dump(self._h, buf, n)
+        if w < 0:
+            raise OSError("dump failed")
+        return buf.raw[:w]
+
+    def load(self, blob: bytes) -> int:
+        n = self._lib.ss_load(self._h, blob, len(blob))
+        if n < 0:
+            raise OSError("malformed dump")
+        return int(n)
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.ss_close(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,250 @@
+"""ClusterDriver — the host polling loop gluing every layer together.
+
+This is the analog of the reference's per-replica libev loop (``polling()``,
+``dare_server.c:1004-1125``) plus the proxy callbacks, but driving ALL
+replicas of an in-process cluster (the simulation/bring-up topology; the
+multi-host deployment runs one driver per host over the same components):
+
+  interposed app ──UDS──▶ ProxyServer ──queue──▶ ClusterDriver.step()
+        ▲                                            │ SimCluster (jitted
+        │ loopback TCP                               ▼  consensus step)
+  ReplayEngine ◀──committed entries──┬── StableStore.append (persist)
+                                     └── ack release (leader's blocked app)
+
+Per iteration: drain shim events into leader batches → run the jitted
+consensus step → persist newly applied entries → replay remote-origin
+entries into local apps → release blocked app threads whose events
+committed → run election timers (heartbeat = the step itself).
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from rdma_paxos_tpu.config import LogConfig, TimeoutConfig
+from rdma_paxos_tpu.consensus.log import EntryType
+from rdma_paxos_tpu.consensus.state import Role
+from rdma_paxos_tpu.proxy.proxy import PendingEvent, ProxyServer, ReplayEngine
+from rdma_paxos_tpu.proxy.stablestore import StableStore
+from rdma_paxos_tpu.runtime.sim import SimCluster
+from rdma_paxos_tpu.runtime.timers import ElectionTimer
+from rdma_paxos_tpu.utils.codec import fragment
+
+
+def conn_origin(conn_id: int) -> int:
+    return conn_id >> 24
+
+
+class _ReplicaRuntime:
+    """Host-side per-replica resources."""
+
+    def __init__(self, idx: int, sock_path: Optional[str],
+                 app_port: Optional[int], store_path: Optional[str],
+                 on_event, timeout_cfg: TimeoutConfig, seed: int):
+        self.idx = idx
+        self.proxy = (ProxyServer(sock_path, idx, on_event)
+                      if sock_path else None)
+        self.replay = (ReplayEngine("127.0.0.1", app_port)
+                       if app_port else None)
+        self.store = StableStore(store_path) if store_path else None
+        # (event, last_fragment_seq) FIFO awaiting commit — every access
+        # must hold the driver lock (link threads append, poll thread pops)
+        self.inflight: collections.deque = collections.deque()
+        self.submit_seq = 0       # monotone per-fragment sequence; stamped
+                                  # into the entry's req_id so ack release
+                                  # is exact across leadership churn
+        self.replay_cursor = 0    # index into cluster.replayed[idx]
+        self.replicated_conns: set = set()   # conns whose events replicate
+        self.passthrough_conns: set = set()  # our own replay connections
+        self.timer = ElectionTimer(timeout_cfg, seed=seed)
+
+
+class ClusterDriver:
+    def __init__(self, cfg: LogConfig, n_replicas: int, *,
+                 workdir: Optional[str] = None,
+                 app_ports: Optional[Sequence[Optional[int]]] = None,
+                 timeout_cfg: Optional[TimeoutConfig] = None,
+                 group_size: Optional[int] = None,
+                 mode: str = "sim", seed: int = 0):
+        self.cfg = cfg
+        self.R = n_replicas
+        self.cluster = SimCluster(cfg, n_replicas, group_size, mode=mode)
+        self.timeout_cfg = timeout_cfg or TimeoutConfig()
+        self._lock = threading.Lock()
+        self._submitq: List[List[Tuple[int, int, bytes, PendingEvent, bool]]]
+        self._submitq = [[] for _ in range(n_replicas)]
+        self._leader_view = -1
+        self.runtimes: List[_ReplicaRuntime] = []
+        for r in range(n_replicas):
+            sock = (os.path.join(workdir, f"proxy{r}.sock")
+                    if workdir else None)
+            store = (os.path.join(workdir, f"replica{r}.db")
+                     if workdir else None)
+            port = app_ports[r] if app_ports else None
+            self.runtimes.append(_ReplicaRuntime(
+                r, sock, port, store,
+                self._make_handler(r), self.timeout_cfg, seed + r))
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------
+    # shim event intake (called from proxy link threads)
+    # ------------------------------------------------------------------
+
+    def _make_handler(self, r: int):
+        def on_event(etype: int, conn_id: int, payload: bytes):
+            """Returns None (pass through), an int status (<0 severs the
+            connection), or a PendingEvent (block until committed)."""
+            with self._lock:
+                rt = self.runtimes[r]
+                if etype == int(EntryType.CONNECT):
+                    # our own replay connections (recognized by peer port)
+                    # stay local; so do client connections on non-leaders
+                    # (stale local reads — the reference's followers serve
+                    # the same way, proxy.c:230-239 is_leader gate)
+                    port = (int.from_bytes(payload[4:6], "big")
+                            if len(payload) >= 6 else 0)
+                    if (rt.replay is not None
+                            and port in rt.replay.local_ports):
+                        rt.passthrough_conns.add(conn_id)
+                        return None
+                    if self._leader_view != r:
+                        return None
+                    rt.replicated_conns.add(conn_id)
+                    payload = b""
+                elif conn_id in rt.passthrough_conns:
+                    if etype == int(EntryType.CLOSE):
+                        rt.passthrough_conns.discard(conn_id)
+                    return None
+                elif conn_id not in rt.replicated_conns:
+                    return None          # never-replicated local session
+                elif self._leader_view != r:
+                    # a REPLICATED session must never silently downgrade
+                    # to unreplicated service after deposition: sever it
+                    # so the client reconnects to the current leader
+                    if etype == int(EntryType.CLOSE):
+                        rt.replicated_conns.discard(conn_id)
+                        return None
+                    return -1
+                if etype == int(EntryType.CLOSE):
+                    rt.replicated_conns.discard(conn_id)
+                frags = (fragment(payload, self.cfg.slot_bytes)
+                         if etype == int(EntryType.SEND) else [payload])
+                ev = PendingEvent(EntryType(etype), conn_id, payload)
+                for f in frags:
+                    rt.submit_seq += 1
+                    self._submitq[r].append((etype, conn_id, f,
+                                             rt.submit_seq))
+                rt.inflight.append((ev, rt.submit_seq))
+                return ev
+        return on_event
+
+    # ------------------------------------------------------------------
+    # the polling loop
+    # ------------------------------------------------------------------
+
+    def step(self) -> Dict:
+        """One host-loop iteration (public for deterministic tests)."""
+        with self._lock:
+            for r in range(self.R):
+                for etype, conn, frag, seq in self._submitq[r]:
+                    self.cluster.submit(r, frag, EntryType(etype),
+                                        conn=conn, req_id=seq)
+                self._submitq[r].clear()
+
+        timeouts = []
+        last = self.cluster.last
+        for r, rt in enumerate(self.runtimes):
+            if last is not None and last["role"][r] == int(Role.LEADER):
+                continue
+            if rt.timer.expired():
+                timeouts.append(r)
+                rt.timer.beat()
+
+        res = self.cluster.step(timeouts=timeouts)
+
+        with self._lock:
+            # multiple self-claimed leaders can coexist transiently (an
+            # isolated deposed leader cannot hear the higher term); the
+            # real one is the highest-term claimant — terms are unique per
+            # leader by quorum election
+            claims = [(int(res["term"][r]), r) for r in range(self.R)
+                      if res["role"][r] == int(Role.LEADER)]
+            self._leader_view = max(claims)[1] if claims else -1
+
+        for r, rt in enumerate(self.runtimes):
+            if res["hb_seen"][r] or res["role"][r] == int(Role.LEADER):
+                rt.timer.beat()
+            self._apply_new_entries(r, rt)
+            if res["role"][r] != int(Role.LEADER):
+                with self._lock:
+                    # lost leadership with blocked app threads: fail them
+                    # so clients reconnect to the new leader (reference
+                    # clients time out the same way). Fragments already
+                    # replicated may still commit later; seq-stamped acks
+                    # make those late applies harmless no-ops.
+                    while rt.inflight:
+                        ev, _ = rt.inflight.popleft()
+                        ev.release(-1)
+        return res
+
+    def _apply_new_entries(self, r: int, rt: _ReplicaRuntime) -> None:
+        stream = self.cluster.replayed[r]
+        progressed = rt.replay_cursor < len(stream)
+        while rt.replay_cursor < len(stream):
+            etype, conn, req, payload = stream[rt.replay_cursor]
+            rt.replay_cursor += 1
+            if rt.store is not None:
+                rec = (bytes([etype]) + conn.to_bytes(4, "little")
+                       + payload)
+                rt.store.append(rec)
+            if conn_origin(conn) != r:
+                if rt.replay is not None:
+                    rt.replay.apply(etype, conn, payload)
+            else:
+                # ack release by sequence: every own-origin entry carries
+                # the fragment seq in req_id, so commits are matched
+                # exactly even across leadership churn
+                with self._lock:
+                    while rt.inflight and rt.inflight[0][1] <= req:
+                        ev, _ = rt.inflight.popleft()
+                        ev.release(0)
+        if progressed:
+            if rt.replay is not None:
+                rt.replay.drain_responses()
+            if rt.store is not None:
+                rt.store.sync()
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    def run(self, period: float = 0.0) -> None:
+        """Run the polling loop in a background thread."""
+        def loop():
+            while not self._stop.is_set():
+                self.step()
+                if period:
+                    time.sleep(period)
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        for rt in self.runtimes:
+            if rt.proxy:
+                rt.proxy.close()
+            if rt.replay:
+                rt.replay.close()
+            if rt.store:
+                rt.store.close()
+
+    def leader(self) -> int:
+        with self._lock:
+            return self._leader_view

@@ -74,8 +74,9 @@ class SimCluster:
         self._inflight: List[List[Tuple[int, int, int, bytes]]] = [
             [] for _ in range(n_replicas)]
         self.last: Optional[Dict[str, np.ndarray]] = None
-        self.replayed: List[List[Tuple[int, int, bytes]]] = [
-            [] for _ in range(n_replicas)]  # (type, conn, payload) per replica
+        # (type, conn_id, req_id, payload) per replica, in apply order
+        self.replayed: List[List[Tuple[int, int, int, bytes]]] = [
+            [] for _ in range(n_replicas)]
 
     # ---------------- client-side API ----------------
 
@@ -172,8 +173,9 @@ class SimCluster:
                              int(EntryType.CLOSE)):
                         ln = int(wm[j, M_LEN])
                         payload = wd[j].astype("<i4").tobytes()[:ln]
-                        self.replayed[r].append((t, int(wm[j, M_CONN]),
-                                                 payload))
+                        self.replayed[r].append(
+                            (t, int(wm[j, M_CONN]), int(wm[j, M_REQID]),
+                             payload))
                 self.applied[r] += n
 
     # ---------------- inspection ----------------

@@ -48,7 +48,7 @@ def test_stale_log_candidate_loses():
     # committed data survives the churn
     res = c.step()
     res = c.step()
-    assert [p for (_, _, p) in c.replayed[2]] == [b"x", b"y"]
+    assert [p for (_, _, _, p) in c.replayed[2]] == [b"x", b"y"]
 
 
 def test_leader_steps_down_on_higher_term():

@@ -46,7 +46,7 @@ def test_replicate_and_commit():
     assert list(res["commit"]) == [3, 3, 3]
     # replay produced the identical byte stream on every replica
     for r in range(3):
-        assert [p for (_, _, p) in c.replayed[r]] == [b"SET k v1",
+        assert [p for (_, _, _, p) in c.replayed[r]] == [b"SET k v1",
                                                       b"SET k v2"]
 
 
@@ -100,7 +100,7 @@ def test_failover_preserves_committed_entries():
     c.submit(1, b"after failover")
     res = c.step()
     assert res["commit"][1] == 4          # durable(2) + NOOP(3) + new(4)
-    replayed1 = [p for (_, _, p) in c.replayed[1]]
+    replayed1 = [p for (_, _, _, p) in c.replayed[1]]
     assert replayed1 == [b"durable", b"after failover"]
 
 
@@ -130,7 +130,7 @@ def test_deposed_leader_rejoins_and_truncates():
     assert list(res["term"]) == [2, 2, 2]
     assert list(res["end"]) == [4, 4, 4]   # committed+NOOP(t2)+winner
     assert list(res["commit"]) == [4, 4, 4]
-    payloads0 = [p for (_, _, p) in c.replayed[0]]
+    payloads0 = [p for (_, _, _, p) in c.replayed[0]]
     assert payloads0 == [b"committed", b"winner"]
 
 
@@ -150,7 +150,7 @@ def test_laggard_catches_up_through_window_floor():
     assert res["end"][2] == 11
     res = c.step()
     assert res["commit"][2] == 11
-    assert [p for (_, _, p) in c.replayed[2]] == [b"e%d" % i
+    assert [p for (_, _, _, p) in c.replayed[2]] == [b"e%d" % i
                                                   for i in range(10)]
 
 
@@ -168,7 +168,7 @@ def test_ring_full_backpressure_retries():
         if not c.pending[0] and c.last["commit"][0] >= total + 1:
             break
     c.step()
-    assert [p for (_, _, p) in c.replayed[1]] == [b"p%04d" % i
+    assert [p for (_, _, _, p) in c.replayed[1]] == [b"p%04d" % i
                                                   for i in range(total)]
 
 

@@ -25,7 +25,7 @@ def test_spmd_replication_8_replicas():
     res = c.step()
     assert list(res["commit"]) == [2] * 8
     for r in range(8):
-        assert [p for (_, _, p) in c.replayed[r]] == [b"spmd!"]
+        assert [p for (_, _, _, p) in c.replayed[r]] == [b"spmd!"]
 
 
 def test_spmd_group3_with_learners():

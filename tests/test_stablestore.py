@@ -1,0 +1,69 @@
+"""Native stable store (BerkeleyDB-RECNO analog) through the ctypes
+binding: append/read/dump/load round trips and crash-truncation recovery."""
+
+import os
+import struct
+import subprocess
+
+import pytest
+
+from rdma_paxos_tpu.proxy.stablestore import StableStore, _NATIVE_DIR
+
+
+@pytest.fixture(scope="module", autouse=True)
+def build_native():
+    subprocess.run(["make", "-C", _NATIVE_DIR, "libstablestore.so"],
+                   check=True, capture_output=True)
+
+
+def test_append_read_roundtrip(tmp_path):
+    with StableStore(str(tmp_path / "a.db")) as s:
+        assert len(s) == 0
+        i0 = s.append(b"hello")
+        i1 = s.append(b"world" * 100)
+        assert (i0, i1) == (0, 1)
+        assert len(s) == 2
+        assert s.read(0) == b"hello"
+        assert s.read(1) == b"world" * 100
+        with pytest.raises(IndexError):
+            s.read(2)
+
+
+def test_reopen_persists(tmp_path):
+    p = str(tmp_path / "b.db")
+    with StableStore(p) as s:
+        for i in range(10):
+            s.append(b"rec%d" % i)
+        s.sync()
+    with StableStore(p) as s:
+        assert len(s) == 10
+        assert s.read(7) == b"rec7"
+
+
+def test_dump_load_snapshot_transfer(tmp_path):
+    """The joiner-recovery path: publisher dumps, joiner loads
+    (dump_records/stablestorage_load_records analog)."""
+    with StableStore(str(tmp_path / "src.db")) as src:
+        for i in range(5):
+            src.append(b"event-%d" % i)
+        blob = src.dump()
+    with StableStore(str(tmp_path / "dst.db")) as dst:
+        assert dst.load(blob) == 5
+        assert len(dst) == 5
+        assert dst.read(4) == b"event-4"
+
+
+def test_torn_tail_record_dropped(tmp_path):
+    """A crash mid-append leaves a torn record; reopen must recover the
+    intact prefix and discard the tail (it was never acked)."""
+    p = str(tmp_path / "c.db")
+    with StableStore(p) as s:
+        s.append(b"good")
+        s.sync()
+    with open(p, "ab") as f:          # simulate torn write
+        f.write(struct.pack("<I", 100) + b"short")
+    with StableStore(p) as s:
+        assert len(s) == 1
+        assert s.read(0) == b"good"
+        s.append(b"next")             # and the store keeps working
+        assert len(s) == 2
