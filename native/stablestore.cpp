@@ -159,6 +159,18 @@ int64_t ss_load(void* h, const void* buf, uint64_t len) {
   return off == len ? n : -1;
 }
 
+// Discard ALL records (used before re-loading a snapshot dump so history
+// is never duplicated by the append-only ss_load).
+int ss_reset(void* h) {
+  auto* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
+  if (ftruncate(s->fd, 0) != 0) return -1;
+  lseek(s->fd, 0, SEEK_SET);
+  s->offsets.clear();
+  s->end = 0;
+  return 0;
+}
+
 void ss_close(void* h) {
   auto* s = static_cast<Store*>(h);
   close(s->fd);

@@ -1,0 +1,94 @@
+"""Snapshot-based recovery — the joiner/straggler catch-up path.
+
+Reference (§3.5 of SURVEY.md): a joiner RDMA-reads a donor's serialized
+BerkeleyDB record stream plus the determinant of the last applied entry
+(``snapshot_t``, ``dare_log.h:105-112``; ``rc_recover_sm``
+``dare_ibv_rc.c:603-710``; ``proxy_apply_db_snapshot`` ``proxy.c:306-339``),
+then RDMA-reads the log tail (``rc_recover_log`` ``:726-856``).
+
+TPU-native equivalent: the app/event state travels as the stable store's
+dump blob (host side, DCN); the device-side install sets the replica's log
+offsets to the snapshot determinant ``(index, term)`` — the Raft
+InstallSnapshot pair — and stamps the determinant term into the slot of
+``index-1`` so the AppendEntries prev-term check passes and ordinary window
+replication takes over from there (no special log-recovery path needed: the
+leader's window floors at the restored ``end``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from rdma_paxos_tpu.consensus.log import M_TERM, slot_of
+from rdma_paxos_tpu.consensus.state import ReplicaState
+
+
+@dataclasses.dataclass
+class Snapshot:
+    """Host-transferable snapshot: consensus determinant + event history."""
+
+    index: int            # last applied entry index + 1 (= donor apply)
+    term: int             # term of entry index-1 (prev-check anchor)
+    store_blob: bytes     # serialized stable store (full event history)
+    epoch: int            # membership epoch at the donor
+    bitmask_old: int
+    bitmask_new: int
+    cid_state: int
+
+
+def take_snapshot(state_b: ReplicaState, donor: int,
+                  store_blob: bytes = b"") -> Snapshot:
+    """Capture a snapshot from replica ``donor`` of a batched state."""
+    apply_ = int(np.asarray(state_b.apply[donor]))
+    n_slots = state_b.log.data.shape[1]
+    term = 0
+    if apply_ > 0:
+        slot = (apply_ - 1) & (n_slots - 1)
+        term = int(np.asarray(state_b.log.meta[donor, slot, M_TERM]))
+    return Snapshot(
+        index=apply_, term=term, store_blob=store_blob,
+        epoch=int(np.asarray(state_b.epoch[donor])),
+        bitmask_old=int(np.asarray(state_b.bitmask_old[donor])),
+        bitmask_new=int(np.asarray(state_b.bitmask_new[donor])),
+        cid_state=int(np.asarray(state_b.cid_state[donor])),
+    )
+
+
+@jax.jit
+def _install(state_b: ReplicaState, r, index, term, epoch, bm_old, bm_new,
+             cid) -> ReplicaState:
+    i32 = jnp.int32
+    n_slots = state_b.log.data.shape[1]
+    # wipe the replica's log row and stamp the determinant term at the
+    # slot of index-1 (the prev-term anchor for the first absorbed window)
+    data = state_b.log.data.at[r].set(0)
+    meta = state_b.log.meta.at[r].set(0)
+    anchor = slot_of(jnp.maximum(index - 1, 0), n_slots)
+    meta = meta.at[r, anchor, M_TERM].set(
+        jnp.where(index > 0, term, 0).astype(i32))
+    log = dataclasses.replace(state_b.log, data=data, meta=meta)
+    sets = dict(head=index, apply=index, commit=index, end=index,
+                term=term, role=1, leader_id=-1,
+                epoch=epoch, bitmask_old=bm_old.astype(jnp.uint32),
+                bitmask_new=bm_new.astype(jnp.uint32), cid_state=cid)
+    out = {k: getattr(state_b, k).at[r].set(
+               jnp.asarray(v).astype(getattr(state_b, k).dtype))
+           for k, v in sets.items()}
+    return dataclasses.replace(state_b, log=log, **out)
+
+
+def install_snapshot(state_b: ReplicaState, r: int,
+                     snap: Snapshot) -> ReplicaState:
+    """Install ``snap`` into replica ``r`` of a batched state: the replica
+    resumes as a follower at the determinant; ordinary replication catches
+    it up from there. The event-history blob is the host's concern
+    (StableStore.load + app replay)."""
+    i32 = lambda v: jnp.asarray(v, jnp.int32)
+    return _install(state_b, i32(r), i32(snap.index), i32(snap.term),
+                    i32(snap.epoch), i32(snap.bitmask_old),
+                    i32(snap.bitmask_new), i32(snap.cid_state))

@@ -113,6 +113,10 @@ class StepOutput:
     acked: jax.Array          # absorbed/verified the leader window this step
     accepted: jax.Array       # client entries actually appended from the
                               # batch (< batch_count ⟹ ring full: RETRY rest)
+    peer_acked: jax.Array     # [R] — which peers acked THIS replica's
+                              # window (meaningful on the leader; feeds the
+                              # host failure detector, check_failure_count
+                              # analog dare_server.c:1189-1227)
 
 
 def make_step_input(cfg: LogConfig, n_replicas: int) -> StepInput:
@@ -346,11 +350,41 @@ def replica_step(
         state.head)
 
     # ------------------------------------------------------------------
+    # CONFIG entries take effect as soon as they are in the log (the
+    # reference's poll_config_entries, dare_server.c:2133-2187; Raft
+    # joint consensus requires the NEW quorum rules from append time, so
+    # this scan runs BEFORE the commit scan): find the newest CONFIG in
+    # the last W entries with a fresher epoch.
+    # ------------------------------------------------------------------
+    scan_g = end3 - 1 - jnp.arange(W, dtype=i32)            # newest first
+    scan_valid = scan_g >= jnp.maximum(state.head, end3 - W)
+    scan_slots = slot_of(jnp.maximum(scan_g, 0), cfg.n_slots)
+    is_config = scan_valid & (
+        log3.meta[scan_slots, M_TYPE] == int(EntryType.CONFIG))
+    cfg_pos = _lex_argmax(is_config, [scan_g])
+    cfg_slot = scan_slots[jnp.maximum(cfg_pos, 0)]
+    cfg_words = log3.data[cfg_slot]                         # payload
+    cfg_epoch = cfg_words[3]
+    take_cfg = (cfg_pos >= 0) & (cfg_epoch > state.epoch)
+    bm_old2 = jnp.where(take_cfg, cfg_words[0].astype(jnp.uint32),
+                        state.bitmask_old)
+    bm_new2 = jnp.where(take_cfg, cfg_words[1].astype(jnp.uint32),
+                        state.bitmask_new)
+    cid2 = jnp.where(take_cfg, cfg_words[2], state.cid_state)
+    epoch2 = jnp.where(take_cfg, cfg_epoch, state.epoch)
+    in_new2 = _popcount_vec(bm_new2, R)
+    in_old2 = _popcount_vec(bm_old2, R)
+    maj_new2 = jnp.sum(in_new2) // 2 + 1
+    maj_old2 = jnp.sum(in_old2) // 2 + 1
+    transit2 = (cid2 == int(ConfigState.TRANSIT)).astype(i32)
+
+    # ------------------------------------------------------------------
     # Phase F — ACK + quorum commit. The ack is the *verified match
     # offset* (everything ≤ the absorbed window end matches the leader's
     # log), gathered from all replicas — the analog of followers RDMA-
     # writing reply[] bytes into the leader's entries. The commit scan
-    # itself is ops/quorum.commit_scan (Pallas on TPU).
+    # itself is ops/quorum.commit_scan (Pallas on TPU), under the
+    # POST-absorb membership config.
     # ------------------------------------------------------------------
     my_ack = jnp.where(can_absorb, m_wstart + m_wcount, 0).astype(i32)
     ack_pair = jnp.stack([my_ack, jnp.where(can_absorb, dom, -1)])
@@ -362,7 +396,7 @@ def replica_step(
         slot_of(state.commit + jnp.arange(W, dtype=i32), cfg.n_slots), M_TERM]
     scanned = commit_scan(
         acks_pad, state.commit, new_term2, end3, terms_win,
-        state.bitmask_old, state.bitmask_new, transit, maj_old, maj_new,
+        bm_old2, bm_new2, transit2, maj_old2, maj_new2,
         use_pallas=use_pallas, interpret=interpret)
     commit2 = jnp.where(i_lead2, jnp.maximum(state.commit, scanned), commit1)
 
@@ -384,26 +418,6 @@ def replica_step(
         jnp.clip(jnp.maximum(head1, min_apply), head1, apply2),
         head1)
 
-    # CONFIG entries take effect as soon as they are in the log (the
-    # reference's poll_config_entries, dare_server.c:2133-2187): scan the
-    # last W entries for the newest CONFIG with a fresher epoch.
-    scan_g = end3 - 1 - jnp.arange(W, dtype=i32)            # newest first
-    scan_valid = scan_g >= jnp.maximum(head2, end3 - W)
-    scan_slots = slot_of(jnp.maximum(scan_g, 0), cfg.n_slots)
-    is_config = scan_valid & (
-        log3.meta[scan_slots, M_TYPE] == int(EntryType.CONFIG))
-    cfg_pos = _lex_argmax(is_config, [scan_g])
-    cfg_slot = scan_slots[jnp.maximum(cfg_pos, 0)]
-    cfg_words = log3.data[cfg_slot]                         # payload
-    cfg_epoch = cfg_words[3]
-    take_cfg = (cfg_pos >= 0) & (cfg_epoch > state.epoch)
-    bm_old2 = jnp.where(take_cfg, cfg_words[0].astype(jnp.uint32),
-                        state.bitmask_old)
-    bm_new2 = jnp.where(take_cfg, cfg_words[1].astype(jnp.uint32),
-                        state.bitmask_new)
-    cid2 = jnp.where(take_cfg, cfg_words[2], state.cid_state)
-    epoch2 = jnp.where(take_cfg, cfg_epoch, state.epoch)
-
     new_state = ReplicaState(
         log=log3, term=new_term2, role=role2, leader_id=leader_id2,
         voted_term=new_voted_term, voted_for=new_voted_for,
@@ -418,6 +432,7 @@ def replica_step(
         became_leader=became.astype(i32),
         acked=can_absorb.astype(i32),
         accepted=(end2 - end1).astype(i32),
+        peer_acked=(heard & (g_acks[:, 1] == me)).astype(i32),
     )
     return new_state, out
 

@@ -1,0 +1,155 @@
+"""Device-native replicated KVS — the built-in state machine.
+
+Reference: ``dare_kvs_sm.c`` implements a chained-hash KVS as the abstract
+state machine (``dare_sm_t`` vtable, ``dare_sm.h:49-60``) with PUT/GET/RM
+(``apply_kvs_cmd`` ``:158-202``). In APUS mode the proxy replaces it; in
+standalone-DARE mode it IS the replicated service and the snapshot unit.
+
+TPU-native redesign: a fixed-capacity **open-addressing** hash table held in
+JAX arrays (SoA), applied with vectorized probe sequences — no chains, no
+pointers, no dynamic allocation:
+
+* ``keys  [cap, KEY_W] i32`` — zero-padded key words
+* ``vals  [cap, VAL_W] i32``
+* ``used  [cap] i32``       — slot occupancy (1 = live)
+
+A lookup hashes the key words (FNV-style mix) and gathers ``PROBES``
+quadratic-probe slots at once; PUT picks the match-or-first-free slot, RM
+tombstones in place (occupancy only — probe chains stay intact because
+probing always scans all ``PROBES`` candidates). Commands arrive as log
+entries (type CSM in the reference; here the KVS consumes SEND-entry
+payloads) and a committed batch applies under ``lax.scan`` — so in
+standalone mode the whole service is jit-compiled end to end.
+
+Command encoding (int32 words): ``[op, key[KEY_W], val[VAL_W]]``,
+op ∈ {1=PUT, 2=GET, 3=RM}.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+OP_PUT, OP_GET, OP_RM = 1, 2, 3
+KEY_W, VAL_W = 8, 8
+CMD_W = 1 + KEY_W + VAL_W
+PROBES = 32   # probe depth bounds the usable load factor (~0.5 is safe)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class KVState:
+    keys: jax.Array   # [cap, KEY_W] i32
+    vals: jax.Array   # [cap, VAL_W] i32
+    used: jax.Array   # [cap] i32
+
+    @property
+    def cap(self) -> int:
+        return self.keys.shape[0]
+
+
+def make_kvs(cap: int = 4096) -> KVState:
+    if cap & (cap - 1):
+        raise ValueError("cap must be a power of two")
+    return KVState(
+        keys=jnp.zeros((cap, KEY_W), jnp.int32),
+        vals=jnp.zeros((cap, VAL_W), jnp.int32),
+        used=jnp.zeros((cap,), jnp.int32),
+    )
+
+
+def _hash(key: jax.Array) -> jax.Array:
+    """FNV-ish mix of the key words to a 31-bit bucket seed."""
+    h = jnp.uint32(2166136261)
+    for i in range(KEY_W):
+        h = (h ^ key[i].astype(jnp.uint32)) * jnp.uint32(16777619)
+    return (h & jnp.uint32(0x7FFFFFFF)).astype(jnp.int32)
+
+
+def _probe_slots(key: jax.Array, cap: int) -> jax.Array:
+    """Quadratic probe sequence, PROBES candidates."""
+    h = _hash(key)
+    i = jnp.arange(PROBES, dtype=jnp.int32)
+    return jnp.bitwise_and(h + i * (i + 1) // 2, cap - 1)
+
+
+def _find(kv: KVState, key: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Returns (slot_of_match_or_-1, first_free_slot_or_-1)."""
+    slots = _probe_slots(key, kv.cap)                   # [P]
+    cand_keys = kv.keys[slots]                          # [P, KEY_W]
+    occupied = kv.used[slots] > 0                       # [P]
+    match = occupied & jnp.all(cand_keys == key[None, :], axis=1)
+    free = ~occupied
+    P = PROBES
+    midx = jnp.min(jnp.where(match, jnp.arange(P), P))
+    fidx = jnp.min(jnp.where(free, jnp.arange(P), P))
+    mslot = jnp.where(midx < P, slots[jnp.minimum(midx, P - 1)], -1)
+    fslot = jnp.where(fidx < P, slots[jnp.minimum(fidx, P - 1)], -1)
+    return mslot, fslot
+
+
+def apply_cmd(kv: KVState, cmd: jax.Array) -> Tuple[KVState, jax.Array]:
+    """Apply one encoded command word-row; returns (kv', value_or_zeros).
+
+    GET returns the value words (zeros if absent); PUT/RM return zeros.
+    Unknown ops are no-ops — a committed garbage entry must not wedge the
+    state machine (apply_kvs_cmd tolerates the same way)."""
+    op = cmd[0]
+    key = cmd[1:1 + KEY_W]
+    val = cmd[1 + KEY_W:1 + KEY_W + VAL_W]
+    mslot, fslot = _find(kv, key)
+
+    target = jnp.where(mslot >= 0, mslot, fslot)
+    do_put = (op == OP_PUT) & (target >= 0)
+    t = jnp.maximum(target, 0)
+    keys = kv.keys.at[t].set(jnp.where(do_put, key, kv.keys[t]))
+    vals = kv.vals.at[t].set(jnp.where(do_put, val, kv.vals[t]))
+    used = kv.used.at[t].set(jnp.where(do_put, 1, kv.used[t]))
+
+    do_rm = (op == OP_RM) & (mslot >= 0)
+    m = jnp.maximum(mslot, 0)
+    used = used.at[m].set(jnp.where(do_rm, 0, used[m]))
+
+    hit = (op == OP_GET) & (mslot >= 0)
+    out = jnp.where(hit, kv.vals[m], jnp.zeros((VAL_W,), jnp.int32))
+    return KVState(keys, vals, used), out
+
+
+def apply_batch(kv: KVState, cmds: jax.Array,
+                count: jax.Array) -> Tuple[KVState, jax.Array]:
+    """Apply ``count`` commands from ``cmds [B, CMD_W]`` in log order via
+    ``lax.scan`` (the committed-window apply of standalone mode)."""
+    B = cmds.shape[0]
+
+    def one(kv, xs):
+        cmd, idx = xs
+        nkv, out = apply_cmd(kv, cmd)
+        skip = idx >= count
+        nkv = jax.tree.map(lambda a, b: jnp.where(skip, a, b), kv, nkv)
+        return nkv, jnp.where(skip, 0, out)
+
+    return jax.lax.scan(one, kv, (cmds, jnp.arange(B, dtype=jnp.int32)))
+
+
+# ---------------------------------------------------------------------------
+# host-side encoding helpers
+# ---------------------------------------------------------------------------
+
+def encode_cmd(op: int, key: bytes, val: bytes = b"") -> np.ndarray:
+    if len(key) > KEY_W * 4 or len(val) > VAL_W * 4:
+        raise ValueError("key/value too large")
+    k = np.zeros(KEY_W * 4, np.uint8)
+    v = np.zeros(VAL_W * 4, np.uint8)
+    k[:len(key)] = np.frombuffer(key, np.uint8)
+    v[:len(val)] = np.frombuffer(val, np.uint8)
+    return np.concatenate([
+        np.array([op], "<i4"),
+        k.view("<i4"), v.view("<i4")]).astype("<i4")
+
+
+def decode_val(words: np.ndarray) -> bytes:
+    return words.astype("<i4").tobytes().rstrip(b"\x00")

@@ -44,6 +44,8 @@ def _load() -> ctypes.CDLL:
     lib.ss_load.restype = ctypes.c_int64
     lib.ss_load.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                             ctypes.c_uint64]
+    lib.ss_reset.restype = ctypes.c_int
+    lib.ss_reset.argtypes = [ctypes.c_void_p]
     lib.ss_close.restype = None
     lib.ss_close.argtypes = [ctypes.c_void_p]
     _lib = lib
@@ -89,6 +91,12 @@ class StableStore:
         if w < 0:
             raise OSError("dump failed")
         return buf.raw[:w]
+
+    def reset(self) -> None:
+        """Discard all records (pre-snapshot-load; ss_load appends, so a
+        reload without reset would duplicate history)."""
+        if self._lib.ss_reset(self._h) != 0:
+            raise OSError("reset failed")
 
     def load(self, blob: bytes) -> int:
         n = self._lib.ss_load(self._h, blob, len(blob))

@@ -25,13 +25,17 @@ import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
+import numpy as np
+
 from rdma_paxos_tpu.config import LogConfig, TimeoutConfig
 from rdma_paxos_tpu.consensus.log import EntryType
-from rdma_paxos_tpu.consensus.state import Role
+from rdma_paxos_tpu.consensus.membership import MembershipManager
+from rdma_paxos_tpu.consensus.snapshot import install_snapshot, take_snapshot
+from rdma_paxos_tpu.consensus.state import ConfigState, Role
 from rdma_paxos_tpu.proxy.proxy import PendingEvent, ProxyServer, ReplayEngine
 from rdma_paxos_tpu.proxy.stablestore import StableStore
 from rdma_paxos_tpu.runtime.sim import SimCluster
-from rdma_paxos_tpu.runtime.timers import ElectionTimer
+from rdma_paxos_tpu.runtime.timers import ElectionTimer, Pacer
 from rdma_paxos_tpu.utils.codec import fragment
 
 
@@ -61,6 +65,11 @@ class _ReplicaRuntime:
         self.replicated_conns: set = set()   # conns whose events replicate
         self.passthrough_conns: set = set()  # our own replay connections
         self.timer = ElectionTimer(timeout_cfg, seed=seed)
+        # false-positive detection for the adaptive timeout (to_adjust_cb
+        # analog): if the SAME leader heartbeats again shortly after we
+        # fired, the timeout was premature -> widen it
+        self.fired_leader = -1
+        self.fired_countdown = 0
 
 
 class ClusterDriver:
@@ -69,13 +78,30 @@ class ClusterDriver:
                  app_ports: Optional[Sequence[Optional[int]]] = None,
                  timeout_cfg: Optional[TimeoutConfig] = None,
                  group_size: Optional[int] = None,
-                 mode: str = "sim", seed: int = 0):
+                 mode: str = "sim", seed: int = 0,
+                 auto_evict: bool = False, fail_threshold: int = 100):
         self.cfg = cfg
         self.R = n_replicas
         self.cluster = SimCluster(cfg, n_replicas, group_size, mode=mode)
         self.timeout_cfg = timeout_cfg or TimeoutConfig()
+        # failure detection / eviction (check_failure_count analog):
+        # consecutive steps each member failed to ack the leader's window
+        self.auto_evict = auto_evict
+        self.fail_threshold = fail_threshold
+        self.fail_count = np.zeros(n_replicas, np.int64)
+        self._mm = MembershipManager(self.cluster)
+        # (phase, new_mask, epoch, steps_left) — steps_left bounds a change
+        # wedged by leader churn losing the CONFIG entry; on expiry the
+        # phase resets so eviction/request can be re-issued
+        self._config_phase: Optional[Tuple[str, int, int, int]] = None
+        self.config_changes_abandoned = 0
+        # recovery requests execute inside the poll loop (never racing the
+        # stepping thread over cluster.state): (replica, donor, done_event)
+        self._recover_req: Optional[Tuple[int, Optional[int],
+                                          threading.Event]] = None
         self._lock = threading.Lock()
-        self._submitq: List[List[Tuple[int, int, bytes, PendingEvent, bool]]]
+        # per-replica queues of (etype, conn_id, fragment_bytes, seq)
+        self._submitq: List[List[Tuple[int, int, bytes, int]]]
         self._submitq = [[] for _ in range(n_replicas)]
         self._leader_view = -1
         self.runtimes: List[_ReplicaRuntime] = []
@@ -149,6 +175,14 @@ class ClusterDriver:
 
     def step(self) -> Dict:
         """One host-loop iteration (public for deterministic tests)."""
+        req = self._recover_req
+        if req is not None:
+            self._recover_req = None
+            r, donor, done = req
+            try:
+                self._do_recover(r, donor)
+            finally:
+                done.set()
         with self._lock:
             for r in range(self.R):
                 for etype, conn, frag, seq in self._submitq[r]:
@@ -164,6 +198,9 @@ class ClusterDriver:
             if rt.timer.expired():
                 timeouts.append(r)
                 rt.timer.beat()
+                rt.fired_leader = (int(last["leader_id"][r])
+                                   if last is not None else -1)
+                rt.fired_countdown = 50
 
         res = self.cluster.step(timeouts=timeouts)
 
@@ -179,6 +216,14 @@ class ClusterDriver:
         for r, rt in enumerate(self.runtimes):
             if res["hb_seen"][r] or res["role"][r] == int(Role.LEADER):
                 rt.timer.beat()
+            if rt.fired_countdown > 0:
+                rt.fired_countdown -= 1
+                if (res["hb_seen"][r] and rt.fired_leader >= 0
+                        and int(res["leader_id"][r]) == rt.fired_leader):
+                    # the leader we timed out on is alive: premature
+                    # timeout -> widen adaptively (to_adjust_cb analog)
+                    rt.timer.false_positive()
+                    rt.fired_countdown = 0
             self._apply_new_entries(r, rt)
             if res["role"][r] != int(Role.LEADER):
                 with self._lock:
@@ -190,7 +235,127 @@ class ClusterDriver:
                     while rt.inflight:
                         ev, _ = rt.inflight.popleft()
                         ev.release(-1)
+
+        self._failure_detector(res)
+        self._drive_config_change()
         return res
+
+    # ------------------------------------------------------------------
+    # failure detection + eviction (push-detection analog: WC failures
+    # -> fail_count >= threshold -> CONFIG removal, dare_server.c:1189)
+    # ------------------------------------------------------------------
+
+    def _failure_detector(self, res) -> None:
+        lead = self._leader_view
+        if lead < 0:
+            self.fail_count[:] = 0
+            return
+        cur = self._mm.current(lead)
+        mask = cur["bitmask_new"]
+        acked = res["peer_acked"][lead]
+        for r in range(self.R):
+            if not (mask >> r) & 1 or r == lead:
+                self.fail_count[r] = 0
+                continue
+            self.fail_count[r] = 0 if acked[r] else self.fail_count[r] + 1
+        if not self.auto_evict or self._config_phase is not None:
+            return
+        dead = [r for r in range(self.R)
+                if (mask >> r) & 1 and self.fail_count[r]
+                >= self.fail_threshold]
+        if dead:
+            new_mask = mask
+            for r in dead:
+                new_mask &= ~(1 << r)
+            # only evict a strict MINORITY: the survivors must form a
+            # majority of the current group, else a transient partition
+            # of live nodes would permanently shrink fault tolerance
+            survivors = bin(new_mask).count("1")
+            if survivors > bin(mask).count("1") // 2:
+                self._mm.submit_transit(lead, mask, new_mask,
+                                        cur["epoch"] + 1)
+                self._config_phase = ("transit", new_mask,
+                                      cur["epoch"] + 1, 500)
+
+    def _drive_config_change(self) -> None:
+        """Advance a two-phase (joint-consensus) config change one poll
+        iteration at a time — the non-blocking version of
+        MembershipManager.change for use inside the polling loop."""
+        if self._config_phase is None:
+            return
+        phase, new_mask, epoch, ttl = self._config_phase
+        if ttl <= 0:
+            # CONFIG entry lost (e.g. leader deposed before it replicated):
+            # abandon so the failure detector / operator can resubmit
+            self._config_phase = None
+            self.config_changes_abandoned += 1
+            return
+        self._config_phase = (phase, new_mask, epoch, ttl - 1)
+        lead = self._leader_view
+        if lead < 0:
+            return
+        cur = self._mm.current(lead)
+        last = self.cluster.last
+        committed = (last is not None and
+                     int(last["commit"][lead]) >= int(last["end"][lead]))
+        if phase == "transit":
+            if (cur["epoch"] >= epoch
+                    and cur["cid_state"] == int(ConfigState.TRANSIT)
+                    and committed):
+                self._mm.submit_stable(lead, new_mask, epoch + 1)
+                self._config_phase = ("stable", new_mask, epoch + 1, ttl)
+        elif phase == "stable":
+            if (cur["epoch"] >= epoch
+                    and cur["cid_state"] == int(ConfigState.STABLE)):
+                self._config_phase = None
+
+    def request_membership(self, new_mask: int) -> None:
+        """Operator API: start a two-phase change to ``new_mask`` (join /
+        upsize / downsize); the polling loop drives it to completion."""
+        lead = self._leader_view
+        if lead < 0:
+            raise RuntimeError("no leader")
+        cur = self._mm.current(lead)
+        self._mm.submit_transit(lead, cur["bitmask_new"], new_mask,
+                                cur["epoch"] + 1)
+        self._config_phase = ("transit", new_mask, cur["epoch"] + 1, 500)
+
+    def recover_replica(self, r: int, donor: Optional[int] = None,
+                        timeout: float = 60.0) -> None:
+        """Snapshot-recover replica ``r`` from ``donor`` (default: current
+        leader): install the consensus determinant and transfer the event
+        history into r's stable store (reset first — never duplicated).
+        The app instance behind r must be fresh (restarted) — its state is
+        rebuilt by replaying the store. Executes inside the poll loop so
+        it never races the stepping thread over cluster state."""
+        done = threading.Event()
+        self._recover_req = (r, donor, done)
+        if self._thread is None or not self._thread.is_alive():
+            self.step()
+        elif not done.wait(timeout):
+            raise TimeoutError("recovery did not run (loop stalled?)")
+
+    def _do_recover(self, r: int, donor: Optional[int]) -> None:
+        donor = self._leader_view if donor is None else donor
+        if donor < 0:
+            raise RuntimeError("no donor available")
+        drt, rrt = self.runtimes[donor], self.runtimes[r]
+        blob = drt.store.dump() if drt.store else b""
+        snap = take_snapshot(self.cluster.state, donor, blob)
+        self.cluster.state = install_snapshot(self.cluster.state, r, snap)
+        self.cluster.applied[r] = snap.index
+        rt_stream = self.cluster.replayed[r]
+        rrt.replay_cursor = len(rt_stream)
+        if rrt.store is not None and snap.store_blob:
+            rrt.store.reset()
+            rrt.store.load(snap.store_blob)
+            if rrt.replay is not None:
+                # rebuild the fresh app by replaying the history blob
+                for i in range(len(rrt.store)):
+                    rec = rrt.store.read(i)
+                    etype, conn = rec[0], int.from_bytes(rec[1:5], "little")
+                    rrt.replay.apply(etype, conn, rec[5:])
+                rrt.replay.drain_responses()
 
     def _apply_new_entries(self, r: int, rt: _ReplicaRuntime) -> None:
         stream = self.cluster.replayed[r]
@@ -224,12 +389,15 @@ class ClusterDriver:
     # ------------------------------------------------------------------
 
     def run(self, period: float = 0.0) -> None:
-        """Run the polling loop in a background thread."""
+        """Run the polling loop in a background thread, paced at
+        ``period`` (the hb_period cadence — each step carries the
+        heartbeat)."""
         def loop():
+            pacer = Pacer(period) if period else None
             while not self._stop.is_set():
                 self.step()
-                if period:
-                    time.sleep(period)
+                if pacer is not None:
+                    pacer.wait()
         self._thread = threading.Thread(target=loop, daemon=True)
         self._thread.start()
 

@@ -1,0 +1,117 @@
+"""Live membership change via joint consensus — the reference's
+EXTENDED→TRANSIT→STABLE config machine (§3.5) driven through CONFIG log
+entries, with dual-quorum enforcement while transitional."""
+
+import numpy as np
+import pytest
+
+from rdma_paxos_tpu.config import LogConfig
+from rdma_paxos_tpu.consensus.membership import MembershipManager
+from rdma_paxos_tpu.consensus.state import ConfigState, Role
+from rdma_paxos_tpu.runtime.sim import SimCluster
+
+CFG = LogConfig(n_slots=64, slot_bytes=32, window_slots=16, batch_slots=8)
+
+
+def test_upsize_3_to_5():
+    c = SimCluster(CFG, 8, group_size=3)
+    mm = MembershipManager(c)
+    c.run_until_elected(0)
+    c.submit(0, b"before")
+    c.step()
+
+    mm.change(0, 0b11111)       # add replicas 3 and 4
+    cur = mm.current(0)
+    assert cur["cid_state"] == int(ConfigState.STABLE)
+    assert cur["bitmask_new"] == 0b11111
+
+    # every member (incl. the new ones) converged on the config
+    for r in range(5):
+        assert mm.current(r)["bitmask_new"] == 0b11111
+
+    # new quorum is 3-of-5: two failures tolerated...
+    c.partition([[0, 1, 2], [3], [4]])
+    c.submit(0, b"with-2-down")
+    res = c.step()
+    assert res["commit"][0] == res["end"][0]
+    # ...three failures not
+    c.partition([[0, 1], [2], [3], [4]])
+    c.submit(0, b"with-3-down")
+    res = c.step()
+    assert res["commit"][0] < res["end"][0]
+    c.heal()
+
+
+def test_downsize_5_to_3():
+    c = SimCluster(CFG, 8, group_size=5)
+    mm = MembershipManager(c)
+    c.run_until_elected(0)
+    mm.change(0, 0b00111)
+    assert mm.current(0)["bitmask_new"] == 0b111
+    # removed replicas no longer count toward quorum: 2-of-3 commits even
+    # with 3 and 4 gone
+    c.partition([[0, 1, 2], [3], [4]])
+    c.submit(0, b"small-group")
+    res = c.step()
+    assert res["commit"][0] == res["end"][0]
+
+
+def test_transit_requires_both_majorities_for_commit():
+    """While TRANSIT is in the log (before STABLE), commits need majorities
+    of BOTH configs — losing the old majority blocks commit even though
+    the new majority is intact (dare_ibv_rc.c:2799-2957 semantics)."""
+    c = SimCluster(CFG, 8, group_size=3)
+    mm = MembershipManager(c)
+    c.run_until_elected(0)
+    c.step()
+    # enter joint consensus 0b111 -> 0b11111 but do NOT finalize
+    mm.submit_transit(0, 0b111, 0b11111, epoch=1)
+    res = c.step()
+    assert mm.current(0)["cid_state"] == int(ConfigState.TRANSIT)
+    committed_to = int(res["commit"][0])
+    # old majority {0,1,2} broken (1,2 gone); new majority {0,3,4} intact
+    c.partition([[0, 3, 4], [1], [2]])
+    c.submit(0, b"blocked")
+    res = c.step()
+    res = c.step()
+    assert int(res["commit"][0]) <= committed_to + 0, (
+        "commit advanced without the old-config majority")
+    # heal -> both quorums available -> commits flow again
+    c.heal()
+    res = c.step()
+    res = c.step()
+    assert int(res["commit"][0]) == int(res["end"][0])
+
+
+def test_eviction_of_failed_member():
+    """Failure-driven downsize (check_failure_count analog,
+    dare_server.c:1189-1227): a permanently dead member is removed so the
+    effective quorum shrinks."""
+    c = SimCluster(CFG, 8, group_size=5)
+    mm = MembershipManager(c)
+    c.run_until_elected(0)
+    c.step()
+    # replicas 3 and 4 die; 3-of-5 quorum still holds, but the operator
+    # (or failure detector) evicts them
+    c.partition([[0, 1, 2], [3], [4]])
+    mm.change(0, 0b00111)
+    assert mm.current(0)["bitmask_new"] == 0b111
+    # now a single further failure is tolerated (2-of-3)
+    c.partition([[0, 1], [2], [3], [4]])
+    c.submit(0, b"after-evict")
+    res = c.step()
+    assert res["commit"][0] == res["end"][0]
+
+
+def test_election_under_new_config_after_upsize():
+    c = SimCluster(CFG, 8, group_size=3)
+    mm = MembershipManager(c)
+    c.run_until_elected(0)
+    mm.change(0, 0b11111)
+    # old leader dies; a NEW member wins an election under the new config
+    c.partition([[0], [1, 2, 3, 4]])
+    res = c.step(timeouts=[3])
+    assert res["role"][3] == int(Role.LEADER)
+    c.submit(3, b"new-member-leads")
+    res = c.step()
+    assert res["commit"][3] == res["end"][3]
