@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""LogGP-style fabric measurement — the ``SRV_TYPE_LOGGP`` mode analog.
+
+The reference measures o (send overhead), o_poll, L (latency), G (per-byte
+gap) of the RDMA fabric with median-of-1000 sampling
+(``rc_get_loggp_params``, ``dare_ibv_rc.c:3323-3597``). Here the unit of
+communication is the replica step, so the measured quantities are:
+
+  o+L  — fixed per-step overhead: median step wall time with an empty
+         window (heartbeat-only step)
+  G    — per-byte gap: slope of step time vs window payload bytes
+  g    — per-entry gap: slope vs entries per step at fixed bytes
+
+Prints one JSON line with the fitted parameters.
+
+    python benchmarks/loggp.py            # real TPU
+    RP_BENCH_CPU=1 python benchmarks/loggp.py
+"""
+
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault(
+    "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+import jax  # noqa: E402
+
+if os.environ.get("RP_BENCH_CPU", "0") == "1":
+    jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from rdma_paxos_tpu.config import LogConfig  # noqa: E402
+from rdma_paxos_tpu.consensus.log import M_LEN, M_TYPE, META_W, EntryType  # noqa: E402
+from rdma_paxos_tpu.consensus.step import StepInput, replica_step  # noqa: E402
+from rdma_paxos_tpu.parallel.mesh import REPLICA_AXIS, stack_states  # noqa: E402
+
+R = 3
+SAMPLES = 50
+
+
+def step_time(cfg, batch_fill, reps=SAMPLES):
+    import functools
+    use_pallas = jax.default_backend() == "tpu"
+    core = functools.partial(replica_step, cfg=cfg, n_replicas=R,
+                             axis_name=REPLICA_AXIS, use_pallas=use_pallas)
+    vstep = jax.jit(jax.vmap(core, in_axes=(0, 0),
+                             axis_name=REPLICA_AXIS),
+                    donate_argnums=(0,))
+    B = cfg.batch_slots
+    bd = jnp.zeros((R, B, cfg.slot_words), jnp.int32)
+    bm = jnp.zeros((R, B, META_W), jnp.int32).at[:, :, M_TYPE].set(
+        int(EntryType.SEND)).at[:, :, M_LEN].set(cfg.slot_bytes)
+    state = stack_states(cfg, R, R)
+
+    def make_inp(count, tmo, commit):
+        return StepInput(
+            batch_data=bd, batch_meta=bm,
+            batch_count=jnp.full((R,), count, jnp.int32),
+            timeout_fired=jnp.zeros((R,), jnp.int32).at[0].set(tmo),
+            peer_mask=jnp.ones((R, R), jnp.int32),
+            apply_done=commit)
+
+    state, _ = vstep(state, make_inp(0, 1, jnp.zeros((R,), jnp.int32)))
+    ts = []
+    for _ in range(reps):
+        inp = make_inp(batch_fill, 0, state.commit)
+        t0 = time.perf_counter()
+        state, out = vstep(state, inp)
+        jax.block_until_ready(out.commit)
+        ts.append(time.perf_counter() - t0)
+    return statistics.median(ts) * 1e6  # us
+
+
+def main():
+    base = dict(n_slots=8192, window_slots=256, batch_slots=256)
+    # o+L: heartbeat-only step (empty window)
+    o_plus_l = step_time(LogConfig(slot_bytes=256, **base), 0)
+    # G: vary bytes at fixed entry count (slot_bytes 128 -> 1024)
+    t_small = step_time(LogConfig(slot_bytes=128, **base), 256)
+    t_big = step_time(LogConfig(slot_bytes=1024, **base), 256)
+    dbytes = 256 * (1024 - 128)
+    G_ns = (t_big - t_small) * 1e3 / dbytes
+    # g: vary entries at fixed slot size
+    t_few = step_time(LogConfig(slot_bytes=256, **base), 32)
+    t_many = step_time(LogConfig(slot_bytes=256, **base), 256)
+    g_ns = (t_many - t_few) * 1e3 / (256 - 32)
+    print(json.dumps({
+        "backend": jax.default_backend(),
+        "o_plus_L_us": round(o_plus_l, 1),
+        "G_ns_per_byte": round(G_ns, 3),
+        "g_ns_per_entry": round(g_ns, 1),
+        "full_step_us": round(t_many, 1),
+        "samples": SAMPLES,
+    }))
+
+
+if __name__ == "__main__":
+    main()

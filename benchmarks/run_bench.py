@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""Replicated-application benchmark — the ``benchmarks/run.sh`` analog.
+
+Boots N replicas of the unmodified toyserver under LD_PRELOAD interposition
++ the in-process consensus driver, finds the leader (same '] LEADER' grep
+contract as the reference, or the driver API), then drives a SET/GET
+workload against the leader's app — measuring committed-op throughput and
+client-visible latency percentiles end to end through the full stack:
+client TCP -> app read() -> shim -> UDS -> consensus step -> quorum commit
+-> ack -> app reply.
+
+    python benchmarks/run_bench.py --replicas 3 --requests 2000 --clients 4
+"""
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+NATIVE = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+
+
+def client_worker(port, n, lat, tid, pipeline=1):
+    """Pipelined client (the redis-benchmark -P analog): P commands per
+    write — the app's read() picks them up as ONE buffer, so they ride a
+    single consensus event; latency is measured per pipelined batch."""
+    s = socket.create_connection(("127.0.0.1", port), timeout=30)
+    f = s.makefile("rb")
+    done = 0
+    while done < n:
+        k = min(pipeline, n - done)
+        t0 = time.perf_counter()
+        s.sendall(b"".join(b"SET k%d-%d v%d\n" % (tid, done + i, i)
+                           for i in range(k)))
+        for _ in range(k):
+            assert f.readline().strip() == b"+OK"
+        lat.append(time.perf_counter() - t0)
+        done += k
+    s.close()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--requests", type=int, default=2000)
+    ap.add_argument("--clients", type=int, default=4)
+    ap.add_argument("--port-base", type=int, default=7600)
+    ap.add_argument("--period", type=float, default=0.0005)
+    ap.add_argument("--pipeline", type=int, default=1,
+                    help="commands per client batch (redis-benchmark -P)")
+    args = ap.parse_args()
+
+    os.environ.setdefault(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    import jax
+    if os.environ.get("RP_BENCH_CPU", "1") == "1":
+        jax.config.update("jax_platforms", "cpu")
+
+    from rdma_paxos_tpu.config import LogConfig, TimeoutConfig
+    from rdma_paxos_tpu.runtime.driver import ClusterDriver
+
+    cfg = LogConfig(n_slots=2048, slot_bytes=512, window_slots=64,
+                    batch_slots=64)
+    ports = [args.port_base + i for i in range(args.replicas)]
+    wd = tempfile.mkdtemp(prefix="rp_bench_")
+    subprocess.run(["make", "-C", NATIVE], check=True, capture_output=True)
+
+    driver = ClusterDriver(
+        cfg, args.replicas, workdir=wd, app_ports=ports,
+        timeout_cfg=TimeoutConfig(elec_timeout_low=0.5,
+                                  elec_timeout_high=1.0))
+    apps = []
+    for r, port in enumerate(ports):
+        env = dict(os.environ)
+        env["LD_PRELOAD"] = os.path.join(NATIVE, "interpose.so")
+        env["RP_PROXY_SOCK"] = os.path.join(wd, f"proxy{r}.sock")
+        apps.append(subprocess.Popen(
+            [os.path.join(NATIVE, "toyserver"), str(port)], env=env,
+            stderr=subprocess.DEVNULL))
+    time.sleep(0.3)
+    driver.run(period=args.period)
+    t0 = time.time()
+    while driver.leader() < 0:
+        time.sleep(0.05)
+        if time.time() - t0 > 120:
+            raise SystemExit("no leader elected")
+    lead = driver.leader()
+    print(f"leader: replica {lead} (elected in {time.time() - t0:.1f}s)")
+
+    per = args.requests // args.clients
+    lat: list = []
+    lats = [[] for _ in range(args.clients)]
+    threads = [threading.Thread(target=client_worker,
+                                args=(ports[lead], per, lats[i], i,
+                                      args.pipeline))
+               for i in range(args.clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    dt = time.perf_counter() - t0
+    for l in lats:
+        lat.extend(l)
+    lat.sort()
+    nb = len(lat)
+    n = per * args.clients
+    print(f"committed SETs: {n} in {dt:.2f}s -> {n / dt:.0f} ops/s "
+          f"({args.clients} clients, pipeline {args.pipeline})")
+    print(f"per-batch latency p50={lat[nb // 2] * 1e3:.2f}ms "
+          f"p95={lat[int(nb * .95)] * 1e3:.2f}ms "
+          f"p99={lat[int(nb * .99)] * 1e3:.2f}ms")
+
+    # replication check on one follower
+    fol = next(r for r in range(args.replicas) if r != lead)
+    time.sleep(1.0)
+    s = socket.create_connection(("127.0.0.1", ports[fol]), timeout=10)
+    f = s.makefile("rb")
+    s.sendall(b"COUNT\n")
+    print(f"follower {fol} kv count: {f.readline().strip().decode()} "
+          f"(leader wrote {n})")
+    s.close()
+
+    driver.stop()
+    for a in apps:
+        a.kill()
+        a.wait()
+
+
+if __name__ == "__main__":
+    main()

@@ -139,3 +139,43 @@ class ClusterConfig:
     @property
     def majority(self) -> int:
         return self.group_size // 2 + 1
+
+
+# ---------------------------------------------------------------------------
+# Config file loading (the libconfig nodes.local.cfg analog, JSON format)
+# ---------------------------------------------------------------------------
+
+def load_config(path: str, env: Optional[dict] = None):
+    """Load a cluster config file — the analog of ``dare_read_config`` +
+    ``proxy_read_config`` over ``nodes.local.cfg`` (reference
+    ``src/config-comp/``), in JSON::
+
+        {
+          "log":     {"n_slots": 16384, "slot_bytes": 256, ...},
+          "timing":  {"hb_period": 0.001, "elec_timeout_low": 0.01, ...},
+          "cluster": {"group_size": 3, "peers": ["h0:9000", ...], ...}
+        }
+
+    Per-instance identity still comes from env vars (``server_idx`` etc.),
+    exactly like the reference. Returns (LogConfig, TimeoutConfig,
+    ClusterConfig)."""
+    import json
+
+    with open(path) as f:
+        raw = json.load(f)
+    log_cfg = LogConfig(**raw.get("log", {}))
+    timing = TimeoutConfig(**raw.get("timing", {}))
+    cluster_raw = dict(raw.get("cluster", {}))
+    if "peers" in cluster_raw:
+        cluster_raw["peers"] = tuple(cluster_raw["peers"])
+    e = os.environ if env is None else env
+    if "server_idx" in e:
+        cluster_raw["server_idx"] = int(e["server_idx"])
+    if "group_size" in e:
+        cluster_raw["group_size"] = int(e["group_size"])
+    if "server_type" in e:
+        cluster_raw["server_type"] = e["server_type"]
+    if "dare_log_file" in e:
+        cluster_raw["log_file"] = e["dare_log_file"]
+    cluster_raw["config_path"] = path
+    return log_cfg, timing, ClusterConfig(**cluster_raw)

@@ -117,6 +117,11 @@ class StepOutput:
                               # window (meaningful on the leader; feeds the
                               # host failure detector, check_failure_count
                               # analog dare_server.c:1189-1227)
+    leadership_verified: jax.Array  # read-index safety: a majority (dual
+                              # majority in transit) accepted this leader's
+                              # authority THIS step, so reads at commit are
+                              # linearizable (rc_verify_leadership analog,
+                              # dare_ibv_rc.c:1182-1280)
 
 
 def make_step_input(cfg: LogConfig, n_replicas: int) -> StepInput:
@@ -433,6 +438,13 @@ def replica_step(
         acked=can_absorb.astype(i32),
         accepted=(end2 - end1).astype(i32),
         peer_acked=(heard & (g_acks[:, 1] == me)).astype(i32),
+        leadership_verified=(
+            i_lead2
+            & (jnp.sum((heard & (g_acks[:, 1] == me)).astype(i32)
+                       * in_new2) >= maj_new2)
+            & ((transit2 <= 0)
+               | (jnp.sum((heard & (g_acks[:, 1] == me)).astype(i32)
+                          * in_old2) >= maj_old2))).astype(i32),
     )
     return new_state, out
 

@@ -36,6 +36,7 @@ from rdma_paxos_tpu.proxy.proxy import PendingEvent, ProxyServer, ReplayEngine
 from rdma_paxos_tpu.proxy.stablestore import StableStore
 from rdma_paxos_tpu.runtime.sim import SimCluster
 from rdma_paxos_tpu.runtime.timers import ElectionTimer, Pacer
+from rdma_paxos_tpu.utils.debug import ReplicaLog
 from rdma_paxos_tpu.utils.codec import fragment
 
 
@@ -48,8 +49,10 @@ class _ReplicaRuntime:
 
     def __init__(self, idx: int, sock_path: Optional[str],
                  app_port: Optional[int], store_path: Optional[str],
-                 on_event, timeout_cfg: TimeoutConfig, seed: int):
+                 on_event, timeout_cfg: TimeoutConfig, seed: int,
+                 log_path: Optional[str] = None):
         self.idx = idx
+        self.log = ReplicaLog(log_path)
         self.proxy = (ProxyServer(sock_path, idx, on_event)
                       if sock_path else None)
         self.replay = (ReplayEngine("127.0.0.1", app_port)
@@ -111,9 +114,12 @@ class ClusterDriver:
             store = (os.path.join(workdir, f"replica{r}.db")
                      if workdir else None)
             port = app_ports[r] if app_ports else None
+            logp = (os.path.join(workdir, f"replica{r}.log")
+                    if workdir else None)
             self.runtimes.append(_ReplicaRuntime(
                 r, sock, port, store,
-                self._make_handler(r), self.timeout_cfg, seed + r))
+                self._make_handler(r), self.timeout_cfg, seed + r,
+                log_path=logp))
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -214,6 +220,8 @@ class ClusterDriver:
             self._leader_view = max(claims)[1] if claims else -1
 
         for r, rt in enumerate(self.runtimes):
+            if res["became_leader"][r]:
+                rt.log.leader_elected(int(res["term"][r]))
             if res["hb_seen"][r] or res["role"][r] == int(Role.LEADER):
                 rt.timer.beat()
             if rt.fired_countdown > 0:
@@ -412,7 +420,18 @@ class ClusterDriver:
                 rt.replay.close()
             if rt.store:
                 rt.store.close()
+            rt.log.close()
 
     def leader(self) -> int:
         with self._lock:
             return self._leader_view
+
+    def can_serve_read(self, r: int) -> bool:
+        """Read-index check: True iff replica ``r`` verified its
+        leadership against a majority on the latest step, so a read of
+        state at its commit index is linearizable (the reference verifies
+        before answering pending reads — ep_dp_reply_read_req,
+        dare_ep_db.c:132-161)."""
+        last = self.cluster.last
+        return (last is not None
+                and bool(last["leadership_verified"][r]))

@@ -136,7 +136,8 @@ class SimCluster:
         res = {k: np.asarray(getattr(out, k))
                for k in ("term", "role", "leader_id", "head", "apply",
                          "commit", "end", "hb_seen", "became_leader",
-                         "acked", "accepted", "peer_acked")}
+                         "acked", "accepted", "peer_acked",
+                         "leadership_verified")}
         # ring-full backpressure: entries the leader could not append are
         # requeued in order (submissions to non-leaders are dropped by
         # design — proxy submits on the leader only)

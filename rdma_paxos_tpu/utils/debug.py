@@ -1,0 +1,72 @@
+"""Per-replica observability log — the ``debug.h`` analog.
+
+The reference writes timestamped protocol events to a per-server file
+(``info/info_wtime`` macros, ``src/include/dare/debug.h:24-106``; file from
+env ``dare_log_file``, ``proxy.c:57-69``), and the benchmark driver finds
+the leader by grepping ``"] LEADER"`` from those logs
+(``benchmarks/run.sh:47-70``, printed at ``dare_server.c:1396``). The exact
+same grep works against these files: on winning an election the driver
+writes ``[T<term>] LEADER``.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional, TextIO
+
+
+class ReplicaLog:
+    def __init__(self, path: Optional[str] = None):
+        self._f: Optional[TextIO] = open(path, "a") if path else None
+        self._t0 = time.time()
+
+    def info(self, msg: str) -> None:
+        if self._f is None:
+            return
+        self._f.write(msg + "\n")
+        self._f.flush()
+
+    def info_wtime(self, msg: str) -> None:
+        """Wall-clock-stamped event line (info_wtime analog)."""
+        if self._f is None:
+            return
+        now = time.time()
+        self._f.write(f"[{now:.6f} +{now - self._t0:8.3f}s] {msg}\n")
+        self._f.flush()
+
+    def leader_elected(self, term: int) -> None:
+        """The exact greppable leader line of the reference
+        (``"[T%d] LEADER"``, dare_server.c:1396, grepped by run.sh)."""
+        self.info_wtime(f"[T{term}] LEADER")
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+
+class StepTimer:
+    """rdtsc-style section timing (timer.h TIMER_START/STOP analog) with
+    µs resolution, accumulated per label."""
+
+    def __init__(self):
+        self.acc = {}
+        self._open = {}
+
+    def start(self, label: str) -> None:
+        self._open[label] = time.perf_counter_ns()
+
+    def stop(self, label: str) -> None:
+        t0 = self._open.pop(label, None)
+        if t0 is not None:
+            us = (time.perf_counter_ns() - t0) / 1e3
+            n, tot, mx = self.acc.get(label, (0, 0.0, 0.0))
+            self.acc[label] = (n + 1, tot + us, max(mx, us))
+
+    def report(self) -> str:
+        lines = []
+        for label, (n, tot, mx) in sorted(self.acc.items()):
+            lines.append(f"{label}: n={n} mean={tot / max(n, 1):.1f}us "
+                         f"max={mx:.1f}us")
+        return "\n".join(lines)
