@@ -1,0 +1,182 @@
+"""NodeDaemon — the per-host replica process for REAL multi-host clusters.
+
+One of these runs on every host (the reference's per-machine app process
+with ``interpose.so`` injected, ``benchmarks/run.sh:24-33``): it owns the
+host's slice of the distributed consensus state (one replica on the local
+chip), the proxy socket its interposed app connects to, the loopback replay
+engine, the stable store, and the election timer.
+
+Lock-step discipline: every loop iteration issues exactly TWO collective
+programs in fixed order — the protocol step, then one window fetch — so
+all hosts stay SPMD-consistent regardless of how their local values differ.
+Hosts synchronize through the collectives themselves (a host that runs
+ahead blocks in the next collective until peers arrive), exactly as the
+reference's followers synchronize through RDMA completion semantics.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from rdma_paxos_tpu.config import ClusterConfig, LogConfig, TimeoutConfig
+from rdma_paxos_tpu.consensus.log import (
+    EntryType, M_CONN, M_LEN, M_REQID, M_TYPE)
+from rdma_paxos_tpu.consensus.state import Role
+from rdma_paxos_tpu.proxy.proxy import PendingEvent, ProxyServer, ReplayEngine
+from rdma_paxos_tpu.proxy.stablestore import StableStore
+from rdma_paxos_tpu.runtime.host import HostReplicaDriver
+from rdma_paxos_tpu.runtime.timers import ElectionTimer
+from rdma_paxos_tpu.utils.codec import fragment
+from rdma_paxos_tpu.utils.debug import ReplicaLog
+
+
+class NodeDaemon:
+    def __init__(self, cfg: LogConfig, *, process_id: int,
+                 num_processes: int, coordinator: str,
+                 workdir: str, app_port: Optional[int] = None,
+                 timeout_cfg: Optional[TimeoutConfig] = None,
+                 group_size: Optional[int] = None, seed: int = 0):
+        self.cfg = cfg
+        self.me = process_id
+        self.hd = HostReplicaDriver(
+            cfg, process_id=process_id, num_processes=num_processes,
+            coordinator=coordinator, group_size=group_size)
+        os.makedirs(workdir, exist_ok=True)
+        self._lock = threading.Lock()
+        self._is_leader = False
+        self._submitq: List[Tuple[int, int, bytes, int]] = []
+        self.inflight: collections.deque = collections.deque()
+        self.submit_seq = 0
+        self.applied = 0
+        self.replicated_conns: set = set()
+        self.passthrough_conns: set = set()
+        self.sock_path = os.path.join(workdir, f"proxy{self.me}.sock")
+        self.proxy = ProxyServer(self.sock_path, self.me, self._on_event)
+        self.replay = (ReplayEngine("127.0.0.1", app_port)
+                       if app_port else None)
+        self.store = StableStore(
+            os.path.join(workdir, f"replica{self.me}.db"))
+        self.log = ReplicaLog(
+            os.path.join(workdir, f"replica{self.me}.log"))
+        self.timer = ElectionTimer(timeout_cfg or TimeoutConfig(),
+                                   seed=seed + process_id)
+        self.last: Optional[Dict] = None
+
+    # ------------------------------------------------------------------
+
+    def _on_event(self, etype: int, conn_id: int, payload: bytes):
+        with self._lock:
+            if etype == int(EntryType.CONNECT):
+                port = (int.from_bytes(payload[4:6], "big")
+                        if len(payload) >= 6 else 0)
+                if (self.replay is not None
+                        and port in self.replay.local_ports):
+                    self.passthrough_conns.add(conn_id)
+                    return None
+                if not self._is_leader:
+                    return None
+                self.replicated_conns.add(conn_id)
+                payload = b""
+            elif conn_id in self.passthrough_conns:
+                if etype == int(EntryType.CLOSE):
+                    self.passthrough_conns.discard(conn_id)
+                return None
+            elif conn_id not in self.replicated_conns:
+                return None
+            elif not self._is_leader:
+                if etype == int(EntryType.CLOSE):
+                    self.replicated_conns.discard(conn_id)
+                    return None
+                return -1
+            if etype == int(EntryType.CLOSE):
+                self.replicated_conns.discard(conn_id)
+            frags = (fragment(payload, self.cfg.slot_bytes)
+                     if etype == int(EntryType.SEND) else [payload])
+            ev = PendingEvent(EntryType(etype), conn_id, payload)
+            for f in frags:
+                self.submit_seq += 1
+                self._submitq.append((etype, conn_id, f, self.submit_seq))
+            self.inflight.append((ev, self.submit_seq))
+            return ev
+
+    # ------------------------------------------------------------------
+
+    def iterate(self) -> Dict:
+        """One lock-step loop iteration (call in unison on every host)."""
+        with self._lock:
+            take = self._submitq[:self.cfg.batch_slots]
+            self._submitq = self._submitq[self.cfg.batch_slots:]
+        # (etype, conn, req_seq, payload) rows for make_input
+        batch = [(t, c, s, f) for (t, c, f, s) in take]
+
+        fire = False
+        if not self._is_leader and self.timer.expired():
+            fire = True
+            self.timer.beat()
+
+        res = self.hd.step(batch=batch, timeout_fired=fire,
+                           apply_done=self.applied)
+        was_leader = self._is_leader
+        with self._lock:
+            self._is_leader = int(res["role"]) == int(Role.LEADER)
+        if res["became_leader"]:
+            self.log.leader_elected(int(res["term"]))
+        if res["hb_seen"] or self._is_leader:
+            self.timer.beat()
+
+        # fixed single fetch per iteration (SPMD-uniform)
+        wd, wm = self.hd.fetch_local_window(self.applied)
+        commit = int(res["commit"])
+        n = min(commit - self.applied, self.cfg.window_slots)
+        progressed = n > 0
+        for j in range(max(n, 0)):
+            etype = int(wm[j, M_TYPE])
+            if etype in (int(EntryType.CONNECT), int(EntryType.SEND),
+                         int(EntryType.CLOSE)):
+                conn = int(wm[j, M_CONN])
+                req = int(wm[j, M_REQID])
+                ln = int(wm[j, M_LEN])
+                payload = wd[j].astype("<i4").tobytes()[:ln]
+                self.store.append(bytes([etype])
+                                  + conn.to_bytes(4, "little") + payload)
+                if (conn >> 24) != self.me:
+                    if self.replay is not None:
+                        self.replay.apply(etype, conn, payload)
+                else:
+                    with self._lock:
+                        while self.inflight and self.inflight[0][1] <= req:
+                            ev, _ = self.inflight.popleft()
+                            ev.release(0)
+        self.applied += max(n, 0)
+        if progressed:
+            if self.replay is not None:
+                self.replay.drain_responses()
+            self.store.sync()
+        if not self._is_leader:
+            with self._lock:
+                while self.inflight:
+                    ev, _ = self.inflight.popleft()
+                    ev.release(-1)
+        self.last = res
+        return res
+
+    def run_iterations(self, n: int, period: float = 0.0) -> None:
+        """Run exactly ``n`` lock-step iterations (every host must use the
+        same ``n`` — collective programs must match across hosts)."""
+        import time
+        for _ in range(n):
+            self.iterate()
+            if period:
+                time.sleep(period)
+
+    def close(self) -> None:
+        self.proxy.close()
+        if self.replay:
+            self.replay.close()
+        self.store.close()
+        self.log.close()

@@ -1,0 +1,87 @@
+"""The COMPLETE reference topology across real OS processes: 3 node
+daemons (one per 'machine'), each running its own unmodified toyserver
+under LD_PRELOAD, coordinating via jax.distributed collectives. A real TCP
+client writes through whichever node won the election (found by the
+reference's '] LEADER' log grep) and the data appears in every follower's
+app."""
+
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+NATIVE = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+PORTS = [7801, 7802, 7803]
+
+
+@pytest.fixture(scope="module", autouse=True)
+def build_native():
+    subprocess.run(["make", "-C", NATIVE], check=True, capture_output=True)
+
+
+def wait_kv(port, key, want, timeout=30.0):
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        try:
+            s = socket.create_connection(("127.0.0.1", port), timeout=5)
+            f = s.makefile("rb")
+            s.sendall(b"GET %s\n" % key)
+            last = f.readline().strip()
+            s.close()
+            if last == want:
+                return last
+        except OSError:
+            pass
+        time.sleep(0.2)
+    return last
+
+
+def test_full_stack_multiprocess(tmp_path):
+    wd = str(tmp_path)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    procs = []
+    for i in range(3):
+        e = dict(env)
+        e["server_idx"] = str(i)
+        e["group_size"] = "3"
+        procs.append(subprocess.Popen(
+            [sys.executable, "benchmarks/launch_node.py",
+             "--coordinator", "127.0.0.1:9931", "--workdir", wd,
+             "--app-port", str(PORTS[i]), "--iterations", "1500"],
+            env=e, cwd="/root/repo",
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+    try:
+        # find the leader the reference way: grep '] LEADER' in the logs
+        leader, deadline = -1, time.time() + 90
+        while leader < 0 and time.time() < deadline:
+            for r in range(3):
+                p = os.path.join(wd, f"replica{r}.log")
+                if os.path.exists(p) and "] LEADER" in open(p).read():
+                    leader = r
+            time.sleep(0.3)
+        assert leader >= 0, "no leader line found"
+
+        s = socket.create_connection(("127.0.0.1", PORTS[leader]),
+                                     timeout=20)
+        f = s.makefile("rb")
+        s.sendall(b"SET dist yes\n")
+        assert f.readline().strip() == b"+OK"
+        s.close()
+
+        for r in range(3):
+            if r == leader:
+                continue
+            assert wait_kv(PORTS[r], b"dist", b"yes") == b"yes", \
+                f"replica {r} missing the replicated write"
+    finally:
+        for p in procs:
+            p.kill()
+            p.wait()
