@@ -26,21 +26,25 @@ from rdma_paxos_tpu.consensus.log import M_LEN, M_TYPE, META_W, EntryType
 from rdma_paxos_tpu.consensus.step import StepInput, replica_step
 from rdma_paxos_tpu.parallel.mesh import REPLICA_AXIS, stack_states
 
-R = 3
 K = 64          # protocol steps per jit call
-CFG = LogConfig(n_slots=16384, slot_bytes=256, window_slots=1024,
+# ring sized 4x the window: gather/scatter cost scales with ring rows (a
+# right-sized ring nearly doubles throughput vs a 16k-slot ring), while the
+# ring must absorb one full batch per step plus the one-step apply lag
+# without hitting the capacity clamp
+CFG = LogConfig(n_slots=4096, slot_bytes=256, window_slots=1024,
                 batch_slots=1024)
 BASELINE_OPS = 1_000_000.0   # BASELINE.md north-star: 1M Redis SET ops/s
 
 
-def build():
+def build(R, cfg=None):
+    cfg = cfg or CFG
     use_pallas = jax.default_backend() == "tpu"
-    core = functools.partial(replica_step, cfg=CFG, n_replicas=R,
+    core = functools.partial(replica_step, cfg=cfg, n_replicas=R,
                              axis_name=REPLICA_AXIS, use_pallas=use_pallas)
     vstep = jax.vmap(core, in_axes=(0, 0), axis_name=REPLICA_AXIS)
 
-    B = CFG.batch_slots
-    batch_data = jnp.zeros((R, B, CFG.slot_words), jnp.int32).at[0, :, 0].set(
+    B = cfg.batch_slots
+    batch_data = jnp.zeros((R, B, cfg.slot_words), jnp.int32).at[0, :, 0].set(
         jnp.arange(B))  # "SET k v" payload stand-in
     batch_meta = jnp.zeros((R, B, META_W), jnp.int32)
     batch_meta = batch_meta.at[:, :, M_TYPE].set(int(EntryType.SEND))
@@ -78,16 +82,12 @@ def build():
     return elect, run_k
 
 
-def main():
-    elect, run_k = build()
-    state = stack_states(CFG, R, R)
+def run_group(R, cfg=None, reps=8):
+    elect, run_k = build(R, cfg)
+    state = stack_states(cfg or CFG, R, R)
     state = elect(state)
-
-    # warmup + compile
-    state, commits = run_k(state)
+    state, commits = run_k(state)      # warmup + compile
     jax.block_until_ready(commits)
-
-    reps = 8
     c0 = int(state.commit[0])
     t0 = time.perf_counter()
     for _ in range(reps):
@@ -95,17 +95,27 @@ def main():
     jax.block_until_ready(commits)
     dt = time.perf_counter() - t0
     committed = int(state.commit[0]) - c0
+    return committed / dt, dt / (reps * K) * 1e6, committed
 
-    ops = committed / dt
-    step_us = dt / (reps * K) * 1e6
+
+def main():
+    # headline: 3-replica group (BASELINE config #1); detail adds the 5-
+    # and 7-replica groups of BASELINE configs #3/#4
+    per_group = {}
+    for R in (3, 5, 7):
+        ops, step_us, committed = run_group(R)
+        per_group[R] = (ops, step_us, committed)
+    ops, step_us, committed = per_group[3]
     print(json.dumps({
         "metric": "consensus_committed_ops_per_sec",
         "value": round(ops, 1),
         "unit": "ops/s",
         "vs_baseline": round(ops / BASELINE_OPS, 4),
         "detail": {
-            "replicas": R, "batch": CFG.batch_slots, "steps": reps * K,
+            "replicas": 3, "batch": CFG.batch_slots,
             "committed": committed, "step_latency_us": round(step_us, 2),
+            "ops_5_replicas": round(per_group[5][0], 1),
+            "ops_7_replicas": round(per_group[7][0], 1),
             "backend": jax.default_backend(),
         },
     }))

@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""Batch experiment harness — the ``eval/eval.py`` analog.
+
+The reference's eval harness configures a cluster from a ``.cfg``, repeats
+runs, collects logs, and plots. This one repeats any of the in-repo
+benchmarks, aggregates their JSON/stdout results, and writes a summary
+(plus a matplotlib plot when available).
+
+    python benchmarks/eval.py --bench device --repeat 3 --out /tmp/eval
+"""
+
+import argparse
+import json
+import os
+import re
+import statistics
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_device_bench(env):
+    out = subprocess.run([sys.executable, os.path.join(ROOT, "bench.py")],
+                         capture_output=True, text=True, env=env,
+                         timeout=900)
+    line = [l for l in out.stdout.splitlines() if l.startswith("{")][-1]
+    return json.loads(line)
+
+
+def run_reconf(env):
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "benchmarks",
+                                      "reconf_bench.py")],
+        capture_output=True, text=True, env=env, timeout=900)
+    res = {}
+    for pat, key in [(r"new leader \d+ in (\d+) ms", "failover_ms"),
+                     (r"first commit after failover \+(\d+) ms",
+                      "first_commit_ms"),
+                     (r"upsize 5->7 committed in (\d+) ms", "upsize_ms"),
+                     (r"dead member removed in (\d+) ms", "evict_ms")]:
+        m = re.search(pat, out.stdout)
+        if m:
+            res[key] = int(m.group(1))
+    return res
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--bench", choices=["device", "reconf"],
+                    default="device")
+    ap.add_argument("--repeat", type=int, default=3)
+    ap.add_argument("--out", default="/tmp/rp_eval")
+    args = ap.parse_args()
+    os.makedirs(args.out, exist_ok=True)
+    env = dict(os.environ)
+
+    runs = []
+    for i in range(args.repeat):
+        t0 = time.time()
+        r = (run_device_bench(env) if args.bench == "device"
+             else run_reconf(env))
+        r["_wall_s"] = round(time.time() - t0, 1)
+        runs.append(r)
+        print(f"run {i}: {json.dumps(r)}")
+
+    summary = {"bench": args.bench, "repeat": args.repeat, "runs": runs}
+    if args.bench == "device":
+        vals = [r["value"] for r in runs]
+        summary["median_ops"] = statistics.median(vals)
+        summary["stdev_ops"] = (statistics.stdev(vals)
+                                if len(vals) > 1 else 0.0)
+    path = os.path.join(args.out, f"eval_{args.bench}.json")
+    with open(path, "w") as f:
+        json.dump(summary, f, indent=2)
+    print(f"summary -> {path}")
+
+    try:
+        import matplotlib
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+        if args.bench == "device":
+            plt.plot([r["value"] for r in runs], marker="o")
+            plt.ylabel("committed ops/s")
+            plt.xlabel("run")
+            plt.savefig(os.path.join(args.out, "eval_device.png"))
+            print(f"plot -> {args.out}/eval_device.png")
+    except Exception:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,61 @@
+#!/usr/bin/env python
+"""Cluster-fabric connectivity smoke test — the ``benchmarks/mckey.c``
+analog. The reference ships a standalone RDMA-CM multicast test because a
+broken multicast group silently breaks JOIN/bootstrap; the failure mode
+here is a broken jax.distributed rendezvous or collective fabric, so this
+spawns N local processes, initializes the coordinator, and runs one psum
+across all of them.
+
+    python benchmarks/rendezvous_check.py --procs 3
+"""
+
+import argparse
+import os
+import subprocess
+import sys
+
+WORKER = r"""
+import os, sys
+pid, n, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("XLA_FLAGS", None)
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize("127.0.0.1:%s" % port, int(n), int(pid))
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+mesh = Mesh(np.array(jax.devices()), ("x",))
+arr = jax.device_put(np.ones(int(n), np.float32),
+                     NamedSharding(mesh, P("x")))
+out = jax.jit(jax.shard_map(lambda v: jax.lax.psum(v, "x"), mesh=mesh,
+                            in_specs=P("x"), out_specs=P()))(arr)
+assert float(out[0]) == float(n), out
+print("proc %s: fabric OK (psum=%d over %s procs)" % (pid, int(out[0]), n),
+      flush=True)
+"""
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--procs", type=int, default=3)
+    ap.add_argument("--port", default="9941")
+    args = ap.parse_args()
+    import tempfile
+    script = os.path.join(tempfile.mkdtemp(), "w.py")
+    with open(script, "w") as f:
+        f.write(WORKER)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    procs = [subprocess.Popen([sys.executable, script, str(i),
+                               str(args.procs), args.port], env=env)
+             for i in range(args.procs)]
+    rc = [p.wait() for p in procs]
+    if any(rc):
+        raise SystemExit(f"fabric check FAILED: exit codes {rc}")
+    print("rendezvous + collective fabric OK")
+
+
+if __name__ == "__main__":
+    main()

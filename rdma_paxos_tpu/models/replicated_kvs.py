@@ -1,0 +1,78 @@
+"""Standalone-DARE mode: the device KVS served directly over consensus.
+
+In the reference, standalone DARE (no app interposition) replicates KVS
+commands as CSM log entries and applies them through the ``dare_sm_t``
+vtable (``dare_server.c:269``, ``dare_kvs_sm.c``); clients read via the
+leader after a leadership verification (``ep_dp_reply_read_req``,
+``dare_ep_db.c:132-161``).
+
+Here: PUT/RM commands ride SEND entries through the same replicated log;
+every replica folds its committed stream into its own device-resident
+:mod:`rdma_paxos_tpu.models.kvs` table; linearizable GETs are served from
+the leader's table only when the latest step verified leadership
+(read-index). Weak (possibly stale) GETs can be served by any replica —
+the same trade the reference's follower apps offer.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from rdma_paxos_tpu.consensus.log import EntryType
+from rdma_paxos_tpu.models.kvs import (
+    CMD_W, OP_GET, OP_PUT, OP_RM, KVState, apply_cmd, decode_val,
+    encode_cmd, make_kvs)
+from rdma_paxos_tpu.runtime.sim import SimCluster
+
+
+class ReplicatedKVS:
+    """KVS service over a :class:`SimCluster` (or a driver's cluster)."""
+
+    def __init__(self, cluster: SimCluster, cap: int = 4096):
+        self.c = cluster
+        self.tables: List[KVState] = [make_kvs(cap)
+                                      for _ in range(cluster.R)]
+        self._cursor = [0] * cluster.R
+        self._apply_jit = jax.jit(apply_cmd)
+
+    # ------------------------------------------------------------------
+
+    def _fold(self, r: int) -> None:
+        """Fold newly committed commands into replica r's table."""
+        stream = self.c.replayed[r]
+        while self._cursor[r] < len(stream):
+            etype, _conn, _req, payload = stream[self._cursor[r]]
+            self._cursor[r] += 1
+            if etype != int(EntryType.SEND):
+                continue
+            if len(payload) != CMD_W * 4:
+                continue                      # not a KVS command: skip
+            cmd = jnp.asarray(np.frombuffer(payload, "<i4"))
+            self.tables[r], _ = self._apply_jit(self.tables[r], cmd)
+
+    # ------------------------------------------------------------------
+
+    def put(self, leader: int, key: bytes, val: bytes) -> None:
+        self.c.submit(leader, encode_cmd(OP_PUT, key, val).tobytes())
+
+    def remove(self, leader: int, key: bytes) -> None:
+        self.c.submit(leader, encode_cmd(OP_RM, key).tobytes())
+
+    def get(self, r: int, key: bytes, *,
+            linearizable: bool = False) -> Optional[bytes]:
+        """Read from replica ``r``'s table. With ``linearizable=True`` the
+        read is refused (returns None) unless ``r`` verified leadership on
+        the latest step — the read-index rule."""
+        if linearizable:
+            last = self.c.last
+            if last is None or not last["leadership_verified"][r]:
+                return None
+        self._fold(r)
+        _, out = self._apply_jit(self.tables[r],
+                                 jnp.asarray(encode_cmd(OP_GET, key)))
+        v = decode_val(np.asarray(out))
+        return v if v else None

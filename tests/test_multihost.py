@@ -55,7 +55,7 @@ print("HOST%d OK commit=%d leader=%d" % (pid, res["commit"],
 
 
 def test_three_process_cluster(tmp_path):
-    port = "9923"
+    port = str(9250 + (os.getpid() % 40))
     env = dict(os.environ)
     env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
     script = tmp_path / "worker.py"

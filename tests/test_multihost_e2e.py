@@ -15,7 +15,9 @@ import pytest
 
 NATIVE = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "native")
-PORTS = [7801, 7802, 7803]
+_BASE = 7800 + (os.getpid() % 400)
+PORTS = [_BASE, _BASE + 400, _BASE + 800]
+COORD_PORT = str(9300 + (os.getpid() % 500))
 
 
 @pytest.fixture(scope="module", autouse=True)
@@ -54,8 +56,8 @@ def test_full_stack_multiprocess(tmp_path):
         e["group_size"] = "3"
         procs.append(subprocess.Popen(
             [sys.executable, "benchmarks/launch_node.py",
-             "--coordinator", "127.0.0.1:9931", "--workdir", wd,
-             "--app-port", str(PORTS[i]), "--iterations", "1500"],
+             "--coordinator", "127.0.0.1:" + COORD_PORT, "--workdir", wd,
+             "--app-port", str(PORTS[i]), "--iterations", "4000"],
             env=e, cwd="/root/repo",
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
     try:
