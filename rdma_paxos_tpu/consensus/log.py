@@ -64,22 +64,39 @@ META_W = 8  # padded for alignment
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class Log:
-    """Per-replica log arrays. ``data[g % n_slots]`` holds the payload of the
-    entry with global index ``g``; ``meta`` its framing."""
+    """Per-replica log. Payload words and framing metadata live FUSED in
+    one ``[n_slots, slot_words + META_W]`` array so every ring gather /
+    scatter in the replication hot path touches a single array (the
+    dominant step cost scales with the number of these ops, measured ~2x
+    win over separate data/meta arrays). ``data`` / ``meta`` are computed
+    column views — XLA fuses the slices away."""
 
-    data: jax.Array   # [n_slots, slot_words] int32
-    meta: jax.Array   # [n_slots, META_W] int32
+    buf: jax.Array    # [n_slots, slot_words + META_W] int32
 
     @property
     def n_slots(self) -> int:
-        return self.data.shape[0]
+        return self.buf.shape[0]
+
+    @property
+    def slot_words(self) -> int:
+        return self.buf.shape[1] - META_W
+
+    @property
+    def data(self) -> jax.Array:   # [n_slots, slot_words]
+        return self.buf[:, :self.slot_words]
+
+    @property
+    def meta(self) -> jax.Array:   # [n_slots, META_W]
+        return self.buf[:, self.slot_words:]
 
 
 def make_log(cfg: LogConfig) -> Log:
-    return Log(
-        data=jnp.zeros((cfg.n_slots, cfg.slot_words), jnp.int32),
-        meta=jnp.zeros((cfg.n_slots, META_W), jnp.int32),
-    )
+    return Log(buf=jnp.zeros((cfg.n_slots, cfg.slot_words + META_W),
+                             jnp.int32))
+
+
+def _fuse(data: jax.Array, meta: jax.Array) -> jax.Array:
+    return jnp.concatenate([data, meta], axis=-1)
 
 
 def slot_of(g: jax.Array, n_slots: int) -> jax.Array:
@@ -133,9 +150,8 @@ def append_batch(
     idx = jnp.where(valid, slot_of(end + offs, n_slots), n_slots)
 
     meta = batch_meta.at[:, M_TERM].set(term)
-    new_data = log.data.at[idx].set(batch_data, mode="drop")
-    new_meta = log.meta.at[idx].set(meta, mode="drop")
-    return Log(new_data, new_meta), end + n
+    new_buf = log.buf.at[idx].set(_fuse(batch_data, meta), mode="drop")
+    return Log(new_buf), end + n
 
 
 # ---------------------------------------------------------------------------
@@ -155,7 +171,8 @@ def extract_window(
     """
     idx = slot_of(start + jnp.arange(window_slots, dtype=jnp.int32),
                   log.n_slots)
-    return log.data[idx], log.meta[idx]
+    w = log.buf[idx]                         # ONE gather for data + meta
+    return w[:, :log.slot_words], w[:, log.slot_words:]
 
 
 def absorb_window(
@@ -206,15 +223,14 @@ def absorb_window(
     mismatch = in_overlap & (local_terms != wmeta[:, M_TERM])
     any_conflict = jnp.any(mismatch)
 
-    # --- scatter the window in ---
+    # --- scatter the window in (one fused scatter) ---
     do_copy = valid & accept
     idx = jnp.where(do_copy, slot_of(g, n_slots), n_slots)
-    new_data = log.data.at[idx].set(wdata, mode="drop")
-    new_meta = log.meta.at[idx].set(wmeta, mode="drop")
+    new_buf = log.buf.at[idx].set(_fuse(wdata, wmeta), mode="drop")
 
     new_end = jnp.where(
         accept,
         jnp.where(any_conflict, wend, jnp.maximum(my_end, wend)),
         my_end,
     ).astype(jnp.int32)
-    return Log(new_data, new_meta), new_end
+    return Log(new_buf), new_end
