@@ -71,23 +71,27 @@ class Log:
     win over separate data/meta arrays). ``data`` / ``meta`` are computed
     column views — XLA fuses the slices away."""
 
-    buf: jax.Array    # [n_slots, slot_words + META_W] int32
+    buf: jax.Array    # [..., n_slots, slot_words + META_W] int32
+
+    # Shape/view properties are axis-agnostic: they work both on a single
+    # replica's [n_slots, cols] buf and on batched [R, n_slots, cols] state
+    # (vmap/stacked), so callers never hand-compute fused-layout offsets.
 
     @property
     def n_slots(self) -> int:
-        return self.buf.shape[0]
+        return self.buf.shape[-2]
 
     @property
     def slot_words(self) -> int:
-        return self.buf.shape[1] - META_W
+        return self.buf.shape[-1] - META_W
 
     @property
-    def data(self) -> jax.Array:   # [n_slots, slot_words]
-        return self.buf[:, :self.slot_words]
+    def data(self) -> jax.Array:   # [..., n_slots, slot_words]
+        return self.buf[..., :self.slot_words]
 
     @property
-    def meta(self) -> jax.Array:   # [n_slots, META_W]
-        return self.buf[:, self.slot_words:]
+    def meta(self) -> jax.Array:   # [..., n_slots, META_W]
+        return self.buf[..., self.slot_words:]
 
 
 def make_log(cfg: LogConfig) -> Log:

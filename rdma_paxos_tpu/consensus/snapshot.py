@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from rdma_paxos_tpu.consensus.log import M_TERM, slot_of
+from rdma_paxos_tpu.consensus.log import Log, M_TERM, META_W, slot_of
 from rdma_paxos_tpu.consensus.state import ReplicaState
 
 
@@ -43,13 +43,19 @@ class Snapshot:
 
 def take_snapshot(state_b: ReplicaState, donor: int,
                   store_blob: bytes = b"") -> Snapshot:
-    """Capture a snapshot from replica ``donor`` of a batched state."""
+    """Capture a snapshot from replica ``donor`` of a batched state.
+
+    Batched state carries the fused log as ``buf[R, n_slots, slot_words +
+    META_W]``; the determinant term of entry ``apply-1`` lives at
+    ``buf[donor, slot, slot_words + M_TERM]``.
+    """
+    log = state_b.log
     apply_ = int(np.asarray(state_b.apply[donor]))
-    n_slots = state_b.log.data.shape[1]
     term = 0
     if apply_ > 0:
-        slot = (apply_ - 1) & (n_slots - 1)
-        term = int(np.asarray(state_b.log.meta[donor, slot, M_TERM]))
+        slot = (apply_ - 1) & (log.n_slots - 1)
+        # single-element device read — never pulls the full log to host
+        term = int(log.buf[donor, slot, log.slot_words + M_TERM])
     return Snapshot(
         index=apply_, term=term, store_blob=store_blob,
         epoch=int(np.asarray(state_b.epoch[donor])),
@@ -63,15 +69,15 @@ def take_snapshot(state_b: ReplicaState, donor: int,
 def _install(state_b: ReplicaState, r, index, term, epoch, bm_old, bm_new,
              cid) -> ReplicaState:
     i32 = jnp.int32
-    n_slots = state_b.log.data.shape[1]
-    # wipe the replica's log row and stamp the determinant term at the
+    n_slots = state_b.log.n_slots
+    slot_words = state_b.log.slot_words
+    # wipe the replica's fused log row and stamp the determinant term at the
     # slot of index-1 (the prev-term anchor for the first absorbed window)
-    data = state_b.log.data.at[r].set(0)
-    meta = state_b.log.meta.at[r].set(0)
+    buf = state_b.log.buf.at[r].set(0)
     anchor = slot_of(jnp.maximum(index - 1, 0), n_slots)
-    meta = meta.at[r, anchor, M_TERM].set(
+    buf = buf.at[r, anchor, slot_words + M_TERM].set(
         jnp.where(index > 0, term, 0).astype(i32))
-    log = dataclasses.replace(state_b.log, data=data, meta=meta)
+    log = Log(buf=buf)
     sets = dict(head=index, apply=index, commit=index, end=index,
                 term=term, role=1, leader_id=-1,
                 epoch=epoch, bitmask_old=bm_old.astype(jnp.uint32),
