@@ -39,8 +39,11 @@ BASELINE_OPS = 1_000_000.0   # BASELINE.md north-star: 1M Redis SET ops/s
 def build(R, cfg=None):
     cfg = cfg or CFG
     use_pallas = jax.default_backend() == "tpu"
+    # full-connectivity bench: the O(W) psum fan-out is the production
+    # configuration (see replica_step's fanout docstring)
     core = functools.partial(replica_step, cfg=cfg, n_replicas=R,
-                             axis_name=REPLICA_AXIS, use_pallas=use_pallas)
+                             axis_name=REPLICA_AXIS, use_pallas=use_pallas,
+                             fanout="psum")
     vstep = jax.vmap(core, in_axes=(0, 0), axis_name=REPLICA_AXIS)
 
     B = cfg.batch_slots

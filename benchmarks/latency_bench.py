@@ -1,0 +1,149 @@
+#!/usr/bin/env python
+"""Commit-latency benchmark — the p99<50µs frontier (BASELINE.md).
+
+The reference commits in single-digit µs via a busy RDMA commit loop
+(``rc_write_remote_logs(wait_for_commit=1)``, ``dare_ibv_rc.c:1870-1948``);
+BASELINE.md sets the TPU target at p99 commit < 50 µs. This bench measures
+the two regimes that bound the TPU design:
+
+* **dispatch mode** — one host→device dispatch per protocol step at small
+  batch (1..64): the client-visible commit latency floor of a step-per-poll
+  driver. Reports p50/p95/p99 over individual dispatches.
+* **scan mode** — K steps fused into one dispatch (``lax.scan``): the
+  amortized per-step device latency with dispatch overhead divided by K —
+  the floor a pipelined/multi-step driver approaches.
+
+Config is latency-tuned (small ring/window — ring gather cost scales with
+rows), 3 replicas, psum fan-out, Pallas quorum scan on TPU.
+
+    python benchmarks/latency_bench.py [--json out.json]
+"""
+
+import argparse
+import dataclasses
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from rdma_paxos_tpu.config import LogConfig
+from rdma_paxos_tpu.consensus.log import EntryType, M_LEN, M_TYPE, META_W
+from rdma_paxos_tpu.consensus.step import StepInput, replica_step
+from rdma_paxos_tpu.parallel.mesh import REPLICA_AXIS, stack_states
+
+R = 3
+K_SCAN = 256
+
+
+def build(cfg: LogConfig, batch: int):
+    use_pallas = jax.default_backend() == "tpu"
+    core = functools.partial(replica_step, cfg=cfg, n_replicas=R,
+                             axis_name=REPLICA_AXIS, use_pallas=use_pallas,
+                             fanout="psum")
+    vstep = jax.vmap(core, in_axes=(0, 0), axis_name=REPLICA_AXIS)
+
+    data = jnp.zeros((R, cfg.batch_slots, cfg.slot_words), jnp.int32)
+    meta = jnp.zeros((R, cfg.batch_slots, META_W), jnp.int32)
+    meta = meta.at[:, :, M_TYPE].set(int(EntryType.SEND))
+    meta = meta.at[:, :, M_LEN].set(16)
+    peer = jnp.ones((R, R), jnp.int32)
+
+    def make_inp(state, count):
+        return StepInput(
+            batch_data=data, batch_meta=meta,
+            batch_count=jnp.full((R,), count, jnp.int32),
+            timeout_fired=jnp.zeros((R,), jnp.int32),
+            peer_mask=peer, apply_done=state.commit)
+
+    @jax.jit
+    def one(state):
+        st, out = vstep(state, make_inp(state, batch))
+        return st, out.commit[0]
+
+    @jax.jit
+    def scan_k(state):
+        def body(st, _):
+            st, out = vstep(st, make_inp(st, batch))
+            return st, out.commit[0]
+        return jax.lax.scan(body, state, None, length=K_SCAN)
+
+    @jax.jit
+    def elect(state):
+        inp = dataclasses.replace(
+            make_inp(state, 0),
+            timeout_fired=jnp.zeros((R,), jnp.int32).at[0].set(1))
+        st, _ = vstep(state, inp)
+        return st
+
+    return elect, one, scan_k
+
+
+def measure(cfg: LogConfig, batch: int, iters: int = 400):
+    elect, one, scan_k = build(cfg, batch)
+    state = stack_states(cfg, R, R)
+    state = elect(state)
+    # warmup / compile
+    state, c = one(state)
+    jax.block_until_ready(c)
+    lat = np.empty(iters)
+    for i in range(iters):
+        t0 = time.perf_counter()
+        state, c = one(state)
+        c.block_until_ready()
+        lat[i] = time.perf_counter() - t0
+    lat.sort()
+    disp = dict(
+        p50_us=float(lat[iters // 2] * 1e6),
+        p95_us=float(lat[int(iters * .95)] * 1e6),
+        p99_us=float(lat[int(iters * .99)] * 1e6),
+    )
+    # scan mode: amortized per-step latency
+    state2 = stack_states(cfg, R, R)
+    state2 = elect(state2)
+    state2, cs = scan_k(state2)          # compile
+    jax.block_until_ready(cs)
+    t0 = time.perf_counter()
+    reps = 4
+    for _ in range(reps):
+        state2, cs = scan_k(state2)
+    jax.block_until_ready(cs)
+    per_step_us = (time.perf_counter() - t0) / (reps * K_SCAN) * 1e6
+    return dict(batch=batch, dispatch=disp,
+                scan_step_us=float(per_step_us),
+                commit_throughput_scan=float(batch / per_step_us * 1e6))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--json", default=None)
+    ap.add_argument("--iters", type=int, default=400)
+    args = ap.parse_args()
+
+    cfg = LogConfig(n_slots=256, slot_bytes=64, window_slots=64,
+                    batch_slots=64)
+    rows = [measure(cfg, b, args.iters) for b in (1, 8, 64)]
+    out = dict(
+        metric="commit_latency_frontier",
+        backend=jax.default_backend(),
+        replicas=R,
+        config=dict(n_slots=cfg.n_slots, slot_bytes=cfg.slot_bytes,
+                    window_slots=cfg.window_slots),
+        target_p99_us=50.0,
+        rows=rows,
+    )
+    print(json.dumps(out, indent=2))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(out, f, indent=2)
+
+
+if __name__ == "__main__":
+    main()

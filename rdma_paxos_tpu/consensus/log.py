@@ -56,8 +56,13 @@ class EntryType(enum.IntEnum):
     HEAD = 6        # log-pruning head advancement     (dare_log.h:25)
 
 
-# Metadata columns (SoA): meta[slot, col]
-M_TYPE, M_TERM, M_CONN, M_REQID, M_LEN = 0, 1, 2, 3, 4
+# Metadata columns (SoA): meta[slot, col]. M_GIDX is the entry's global
+# monotone index, stamped at append time — it lets a full-ring scan
+# reconstruct which slots are live ([head, end)) without walking offsets,
+# e.g. the CONFIG-derivation scan in consensus/step.py. A recycled slot's
+# stale gidx is always < head (the ring holds <= n_slots live entries), so
+# `gidx >= head` alone identifies liveness.
+M_TYPE, M_TERM, M_CONN, M_REQID, M_LEN, M_GIDX = 0, 1, 2, 3, 4, 5
 META_W = 8  # padded for alignment
 
 
@@ -154,6 +159,7 @@ def append_batch(
     idx = jnp.where(valid, slot_of(end + offs, n_slots), n_slots)
 
     meta = batch_meta.at[:, M_TERM].set(term)
+    meta = meta.at[:, M_GIDX].set(end + offs)
     new_buf = log.buf.at[idx].set(_fuse(batch_data, meta), mode="drop")
     return Log(new_buf), end + n
 

@@ -30,12 +30,19 @@ from rdma_paxos_tpu.consensus.state import ReplicaState
 
 @dataclasses.dataclass
 class Snapshot:
-    """Host-transferable snapshot: consensus determinant + event history."""
+    """Host-transferable snapshot: consensus determinant + event history.
+
+    The config fields are the donor's COMMITTED-config checkpoint
+    (``ccfg_*``), not its live adopted config: a live-but-uncommitted
+    CONFIG entry always has ``gidx >= commit >= apply = index``, so the
+    recovered replica re-absorbs it through ordinary window replication if
+    it survives — and must NOT inherit it if it is truncated cluster-wide
+    (the abandoned-config trap)."""
 
     index: int            # last applied entry index + 1 (= donor apply)
     term: int             # term of entry index-1 (prev-check anchor)
     store_blob: bytes     # serialized stable store (full event history)
-    epoch: int            # membership epoch at the donor
+    epoch: int            # committed membership epoch at the donor
     bitmask_old: int
     bitmask_new: int
     cid_state: int
@@ -58,19 +65,20 @@ def take_snapshot(state_b: ReplicaState, donor: int,
         term = int(log.buf[donor, slot, log.slot_words + M_TERM])
     return Snapshot(
         index=apply_, term=term, store_blob=store_blob,
-        epoch=int(np.asarray(state_b.epoch[donor])),
-        bitmask_old=int(np.asarray(state_b.bitmask_old[donor])),
-        bitmask_new=int(np.asarray(state_b.bitmask_new[donor])),
-        cid_state=int(np.asarray(state_b.cid_state[donor])),
+        epoch=int(np.asarray(state_b.ccfg_epoch[donor])),
+        bitmask_old=int(np.asarray(state_b.ccfg_old[donor])),
+        bitmask_new=int(np.asarray(state_b.ccfg_new[donor])),
+        cid_state=int(np.asarray(state_b.ccfg_cid[donor])),
     )
 
 
 @jax.jit
-def _install(state_b: ReplicaState, r, index, term, epoch, bm_old, bm_new,
-             cid) -> ReplicaState:
+def _install(state_b: ReplicaState, r, index, term, cur_term, voted_term,
+             voted_for, epoch, bm_old, bm_new, cid) -> ReplicaState:
     i32 = jnp.int32
     n_slots = state_b.log.n_slots
     slot_words = state_b.log.slot_words
+    n_rec = state_b.vote_rec_term.shape[1]
     # wipe the replica's fused log row and stamp the determinant term at the
     # slot of index-1 (the prev-term anchor for the first absorbed window)
     buf = state_b.log.buf.at[r].set(0)
@@ -78,23 +86,66 @@ def _install(state_b: ReplicaState, r, index, term, epoch, bm_old, bm_new,
     buf = buf.at[r, anchor, slot_words + M_TERM].set(
         jnp.where(index > 0, term, 0).astype(i32))
     log = Log(buf=buf)
+    bm_old_u = bm_old.astype(jnp.uint32)
+    bm_new_u = bm_new.astype(jnp.uint32)
     sets = dict(head=index, apply=index, commit=index, end=index,
-                term=term, role=1, leader_id=-1,
-                epoch=epoch, bitmask_old=bm_old.astype(jnp.uint32),
-                bitmask_new=bm_new.astype(jnp.uint32), cid_state=cid)
+                term=cur_term, role=1, leader_id=-1,
+                voted_term=voted_term, voted_for=voted_for,
+                # a fresh process has no memory of peers' votes
+                vote_rec_term=jnp.zeros((n_rec,), i32),
+                vote_rec_for=jnp.full((n_rec,), -1, i32),
+                epoch=epoch, bitmask_old=bm_old_u, bitmask_new=bm_new_u,
+                cid_state=cid,
+                # the snapshot's config IS the donor's committed-config
+                # checkpoint (see Snapshot docstring); the wiped log holds
+                # no CONFIG entries, so the first derivation falls back
+                # here, and any surviving newer CONFIG re-arrives through
+                # window replication
+                ccfg_old=bm_old_u, ccfg_new=bm_new_u, ccfg_cid=cid,
+                ccfg_epoch=epoch)
     out = {k: getattr(state_b, k).at[r].set(
                jnp.asarray(v).astype(getattr(state_b, k).dtype))
            for k, v in sets.items()}
     return dataclasses.replace(state_b, log=log, **out)
 
 
-def install_snapshot(state_b: ReplicaState, r: int,
-                     snap: Snapshot) -> ReplicaState:
+def recover_vote(state_b: ReplicaState, r: int,
+                 peers=None) -> tuple:
+    """Read replica ``r``'s replicated vote back from peers' vote records
+    — the ``rc_get_replicated_vote`` analog (``dare_ibv_rc.c:394-473``).
+    Returns the newest ``(voted_term, voted_for)`` any queried peer
+    retains for ``r`` (query BEFORE installing a snapshot into ``r``).
+    ``peers`` defaults to everyone EXCEPT ``r`` — a crashed replica's own
+    in-memory record is exactly what the crash lost, so consulting it
+    would mask real double-vote hazards in simulation."""
+    if peers is None:
+        peers = [p for p in range(state_b.vote_rec_term.shape[0])
+                 if p != r]
+    sel = list(peers)
+    vt = np.asarray(state_b.vote_rec_term[sel, r])
+    vf = np.asarray(state_b.vote_rec_for[sel, r])
+    if vt.size == 0:
+        return 0, -1
+    i = int(vt.argmax())
+    return int(vt[i]), int(vf[i])
+
+
+def install_snapshot(state_b: ReplicaState, r: int, snap: Snapshot, *,
+                     voted_term: int = 0, voted_for: int = -1,
+                     cur_term: int = 0) -> ReplicaState:
     """Install ``snap`` into replica ``r`` of a batched state: the replica
     resumes as a follower at the determinant; ordinary replication catches
     it up from there. The event-history blob is the host's concern
-    (StableStore.load + app replay)."""
+    (StableStore.load + app replay).
+
+    ``voted_term``/``voted_for``/``cur_term`` restore election durability
+    across the crash (HardState file + ``recover_vote`` peer records): the
+    current term is floored at both the snapshot term and the recovered
+    vote term, so a recovered replica can never re-grant a vote it already
+    cast (reference ``rc_get_replicated_vote``)."""
     i32 = lambda v: jnp.asarray(v, jnp.int32)
+    eff_term = max(int(snap.term), int(cur_term), int(voted_term))
     return _install(state_b, i32(r), i32(snap.index), i32(snap.term),
+                    i32(eff_term), i32(voted_term), i32(voted_for),
                     i32(snap.epoch), i32(snap.bitmask_old),
                     i32(snap.bitmask_new), i32(snap.cid_state))

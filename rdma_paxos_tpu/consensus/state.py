@@ -62,6 +62,14 @@ class ReplicaState:
     # --- election durability (rc_replicate_vote, dare_ibv_rc.c:1049) ---
     voted_term: jax.Array   # i32 — highest term in which we voted
     voted_for: jax.Array    # i32 — candidate voted for in voted_term
+    # Peer vote records — the rc_replicate_vote durability analog: every
+    # replica retains, for each peer, the newest (voted_term, voted_for)
+    # pair it has heard in the vote gather. A crash-recovered replica
+    # restores its own vote by reading these records back from live peers
+    # (rc_get_replicated_vote, dare_ibv_rc.c:394-473), so it can never
+    # grant a second vote in a term where its first vote was counted.
+    vote_rec_term: jax.Array  # [R] i32 — peer r's voted_term as heard
+    vote_rec_for: jax.Array   # [R] i32 — peer r's voted_for as heard
     # --- log offsets (dare_log.h:77-103) ---
     head: jax.Array         # i32 — oldest retained entry
     apply: jax.Array        # i32 — applied up to here (host echoes back)
@@ -72,15 +80,27 @@ class ReplicaState:
     bitmask_old: jax.Array  # u32 — member bitmask (old config)
     bitmask_new: jax.Array  # u32 — member bitmask (new/current config)
     epoch: jax.Array        # i32 — config epoch (bumped per change)
+    # Committed-config checkpoint — the newest CONFIG entry known
+    # committed. The live config above is DERIVED each step as "newest
+    # CONFIG entry retained in the log, else this checkpoint" (Raft's
+    # latest-configuration-in-the-log rule), so truncating an uncommitted
+    # CONFIG entry automatically rolls the config back instead of leaving
+    # an abandoned config adopted forever.
+    ccfg_old: jax.Array     # u32
+    ccfg_new: jax.Array     # u32
+    ccfg_cid: jax.Array     # i32
+    ccfg_epoch: jax.Array   # i32
 
 
 def make_replica_state(
     cfg: LogConfig,
     group_size: int,
+    n_replicas: int | None = None,
     *,
     role: Role = Role.FOLLOWER,
 ) -> ReplicaState:
     i32 = lambda v: jnp.asarray(v, jnp.int32)
+    R = n_replicas if n_replicas is not None else group_size
     mask = jnp.asarray((1 << group_size) - 1, jnp.uint32)
     return ReplicaState(
         log=make_log(cfg),
@@ -89,6 +109,8 @@ def make_replica_state(
         leader_id=i32(-1),
         voted_term=i32(0),
         voted_for=i32(-1),
+        vote_rec_term=jnp.zeros((R,), jnp.int32),
+        vote_rec_for=jnp.full((R,), -1, jnp.int32),
         head=i32(0),
         apply=i32(0),
         commit=i32(0),
@@ -97,4 +119,8 @@ def make_replica_state(
         bitmask_old=mask,
         bitmask_new=mask,
         epoch=i32(0),
+        ccfg_old=mask,
+        ccfg_new=mask,
+        ccfg_cid=i32(int(ConfigState.STABLE)),
+        ccfg_epoch=i32(0),
     )

@@ -67,7 +67,7 @@ from jax import lax
 
 from rdma_paxos_tpu.config import LogConfig
 from rdma_paxos_tpu.consensus.log import (
-    EntryType, Log, M_TERM, M_TYPE, META_W,
+    EntryType, Log, M_GIDX, M_TERM, M_TYPE, META_W,
     append_batch, absorb_window, extract_window, last_term, slot_of,
 )
 from rdma_paxos_tpu.consensus.state import ConfigState, ReplicaState, Role
@@ -104,6 +104,8 @@ class StepOutput:
     term: jax.Array
     role: jax.Array
     leader_id: jax.Array
+    voted_term: jax.Array     # durable vote pair — the host persists these
+    voted_for: jax.Array      #   to HardState between steps
     head: jax.Array
     apply: jax.Array
     commit: jax.Array
@@ -162,10 +164,36 @@ def replica_step(
     axis_name: str = "replica",
     use_pallas: bool = False,
     interpret: bool = False,
+    fanout: str = "gather",
 ) -> Tuple[ReplicaState, StepOutput]:
     """One protocol step for this replica (call under ``shard_map`` over the
     ``replica`` mesh axis, or under ``vmap(axis_name=...)`` for single-chip
-    simulation — see ``parallel/mesh.py``)."""
+    simulation — see ``parallel/mesh.py``).
+
+    ``fanout`` selects how the leader's window reaches followers:
+
+    * ``"gather"`` — every replica ``all_gather``s a (zeroed-unless-leader)
+      window and receivers SELECT the dominant claimant's row. Split-brain
+      safe under arbitrary ``peer_mask`` partitions (two self-claimed
+      leaders cannot corrupt each other's payload), at O(R·W·slot_bytes)
+      ICI traffic per replica. Required for partition simulation.
+    * ``"psum"`` — the leader's window is broadcast as a masked ``psum``:
+      O(W·slot_bytes) per replica (bandwidth independent of R — the analog
+      of the reference's per-follower delta writes costing the leader one
+      NIC pass, ``dare_ibv_rc.c:1526-1642``). Sound ONLY under full
+      connectivity (``peer_mask`` all-ones — the real ICI mesh, where a
+      chip failure kills the whole program rather than partitioning it):
+      with full pairwise hearing, Phase B leaves at most one replica in
+      the LEADER role per step (any lower-term leader hears the higher
+      term and steps down; same-term double-win is impossible by election
+      safety), so the psum has at most one contributor and equals the
+      dominant row the gather path would have selected. The tiny scalar
+      claim gather is kept — receivers still term-gate absorption, so
+      even a violated assumption degrades to a rejected window, not a
+      corrupted log... except the summed payload itself; hence the
+      partition-capable paths (SimCluster default, fuzzer) keep "gather".
+    """
+    assert fanout in ("gather", "psum"), fanout
     i32 = jnp.int32
     R, W = n_replicas, cfg.window_slots
     me = lax.axis_index(axis_name).astype(i32)
@@ -208,9 +236,12 @@ def replica_step(
     cand_term = g_term + 1
     i_cand = is_cand[me] & (state.role != int(Role.LEADER))
 
-    # voter logic (vote durability: the all_gather below IS the vote
-    # replication of rc_replicate_vote; the host additionally persists
-    # voted_term/voted_for to stable storage between steps)
+    # voter logic (vote durability: the vote all_gather below replicates
+    # the durable (voted_term, voted_for) pair to every live peer, which
+    # RETAINS it in vote_rec_* — the rc_replicate_vote analog; the host
+    # additionally persists the pair to a HardState file between steps,
+    # and recovery restores max(persisted, peer records) — see
+    # consensus/snapshot.py recover_vote)
     can_grant = (
         heard & is_cand
         & (cand_term >= state.term)
@@ -228,8 +259,15 @@ def replica_step(
         state.voted_term)
     new_voted_for = jnp.where(vote_cast, my_vote, state.voted_for)
 
-    votes = lax.all_gather(my_vote, axis_name)              # [R]
+    vote_msg = jnp.stack([my_vote, new_voted_term, new_voted_for])
+    g_votes = lax.all_gather(vote_msg, axis_name)           # [R, 3]
+    votes = g_votes[:, 0]
     got = (votes == me) & heard
+    # retain every peer's newest durable vote pair (rc_replicate_vote
+    # analog) so a crash-recovered peer can read its vote back from us
+    rec_upd = heard & (g_votes[:, 1] > state.vote_rec_term)
+    vote_rec_term2 = jnp.where(rec_upd, g_votes[:, 1], state.vote_rec_term)
+    vote_rec_for2 = jnp.where(rec_upd, g_votes[:, 2], state.vote_rec_for)
     win = (
         i_cand
         & (jnp.sum(got.astype(i32) * in_new) >= maj_new)
@@ -300,8 +338,6 @@ def replica_step(
     msg_scal = msg_scal.at[S_HEAD].set(state.head)
 
     contrib = jnp.where(i_lead, 1, 0)
-    gw_data = lax.all_gather(wdata * contrib, axis_name)    # [R, W, sw]
-    gw_meta = lax.all_gather(wmeta * contrib, axis_name)    # [R, W, MW]
     gw_scal = lax.all_gather(msg_scal * contrib, axis_name)  # [R, S_N]
 
     # dominant leader: the highest-term valid claim this replica can hear
@@ -311,6 +347,17 @@ def replica_step(
     dsafe = jnp.maximum(dom, 0)
     m_scal = gw_scal[dsafe]
     m_term = m_scal[S_TERM]
+
+    if fanout == "psum":
+        # single-contributor broadcast (see docstring for the safety
+        # argument): O(W) bandwidth instead of O(R·W)
+        m_data = lax.psum(wdata * contrib, axis_name)       # [W, sw]
+        m_meta = lax.psum(wmeta * contrib, axis_name)       # [W, MW]
+    else:
+        gw_data = lax.all_gather(wdata * contrib, axis_name)  # [R, W, sw]
+        gw_meta = lax.all_gather(wmeta * contrib, axis_name)  # [R, W, MW]
+        m_data = gw_data[dsafe]
+        m_meta = gw_meta[dsafe]
 
     # ------------------------------------------------------------------
     # Phase E — absorb (uniform; the leader absorbs its own window as a
@@ -336,7 +383,7 @@ def replica_step(
     can_absorb = use & ~gap & prev_ok
 
     log3, end3 = absorb_window(
-        log2, end2, gw_data[dsafe], gw_meta[dsafe], m_wstart,
+        log2, end2, m_data, m_meta, m_wstart,
         jnp.where(can_absorb, m_wcount, 0))
     # backoff: advertised end rewinds to just before the mismatch (never
     # below commit — committed entries cannot conflict)
@@ -355,28 +402,30 @@ def replica_step(
         state.head)
 
     # ------------------------------------------------------------------
-    # CONFIG entries take effect as soon as they are in the log (the
-    # reference's poll_config_entries, dare_server.c:2133-2187; Raft
-    # joint consensus requires the NEW quorum rules from append time, so
-    # this scan runs BEFORE the commit scan): find the newest CONFIG in
-    # the last W entries with a fresher epoch.
+    # CONFIG derivation — Raft's latest-configuration-in-the-log rule:
+    # the live config is the newest CONFIG entry retained in [head, end)
+    # (full-ring scan over the stamped M_GIDX column), else the committed
+    # checkpoint ccfg_*. CONFIG entries take effect from append/absorb
+    # time (poll_config_entries, dare_server.c:2133-2187), and because the
+    # config is RE-derived from the log every step, truncating an
+    # uncommitted CONFIG entry under the divergence rule automatically
+    # rolls the config back to the newest surviving one — the abandoned-
+    # config trap of an incremental epoch-gated adoption cannot occur.
+    # Runs BEFORE the commit scan (joint consensus needs the new quorum
+    # rules from append time).
     # ------------------------------------------------------------------
-    scan_g = end3 - 1 - jnp.arange(W, dtype=i32)            # newest first
-    scan_valid = scan_g >= jnp.maximum(state.head, end3 - W)
-    scan_slots = slot_of(jnp.maximum(scan_g, 0), cfg.n_slots)
-    is_config = scan_valid & (
-        log3.meta[scan_slots, M_TYPE] == int(EntryType.CONFIG))
-    cfg_pos = _lex_argmax(is_config, [scan_g])
-    cfg_slot = scan_slots[jnp.maximum(cfg_pos, 0)]
-    cfg_words = log3.data[cfg_slot]                         # payload
-    cfg_epoch = cfg_words[3]
-    take_cfg = (cfg_pos >= 0) & (cfg_epoch > state.epoch)
-    bm_old2 = jnp.where(take_cfg, cfg_words[0].astype(jnp.uint32),
-                        state.bitmask_old)
-    bm_new2 = jnp.where(take_cfg, cfg_words[1].astype(jnp.uint32),
-                        state.bitmask_new)
-    cid2 = jnp.where(take_cfg, cfg_words[2], state.cid_state)
-    epoch2 = jnp.where(take_cfg, cfg_epoch, state.epoch)
+    all_gidx = log3.meta[:, M_GIDX]                         # [n_slots]
+    live_cfg = ((log3.meta[:, M_TYPE] == int(EntryType.CONFIG))
+                & (all_gidx >= head1) & (all_gidx < end3))
+    cfg_pos = _lex_argmax(live_cfg, [all_gidx])
+    cfg_words = log3.data[jnp.maximum(cfg_pos, 0)]          # payload
+    have_cfg = cfg_pos >= 0
+    bm_old2 = jnp.where(have_cfg, cfg_words[0].astype(jnp.uint32),
+                        state.ccfg_old)
+    bm_new2 = jnp.where(have_cfg, cfg_words[1].astype(jnp.uint32),
+                        state.ccfg_new)
+    cid2 = jnp.where(have_cfg, cfg_words[2], state.ccfg_cid)
+    epoch2 = jnp.where(have_cfg, cfg_words[3], state.ccfg_epoch)
     in_new2 = _popcount_vec(bm_new2, R)
     in_old2 = _popcount_vec(bm_old2, R)
     maj_new2 = jnp.sum(in_new2) // 2 + 1
@@ -423,15 +472,34 @@ def replica_step(
         jnp.clip(jnp.maximum(head1, min_apply), head1, apply2),
         head1)
 
+    # committed-config checkpoint: the newest CONFIG entry now below
+    # commit can never be truncated (backoff floors at commit), so it
+    # becomes the fallback when the ring holds no live CONFIG entry
+    # (pruned past, or every newer CONFIG was truncated).
+    live_ccfg = live_cfg & (all_gidx < commit2)
+    ccpos = _lex_argmax(live_ccfg, [all_gidx])
+    ccw = log3.data[jnp.maximum(ccpos, 0)]
+    have_cc = ccpos >= 0
+    ccfg_old2 = jnp.where(have_cc, ccw[0].astype(jnp.uint32),
+                          state.ccfg_old)
+    ccfg_new2 = jnp.where(have_cc, ccw[1].astype(jnp.uint32),
+                          state.ccfg_new)
+    ccfg_cid2 = jnp.where(have_cc, ccw[2], state.ccfg_cid)
+    ccfg_epoch2 = jnp.where(have_cc, ccw[3], state.ccfg_epoch)
+
     new_state = ReplicaState(
         log=log3, term=new_term2, role=role2, leader_id=leader_id2,
         voted_term=new_voted_term, voted_for=new_voted_for,
+        vote_rec_term=vote_rec_term2, vote_rec_for=vote_rec_for2,
         head=head2, apply=apply2, commit=commit2, end=end3,
         cid_state=cid2, bitmask_old=bm_old2, bitmask_new=bm_new2,
         epoch=epoch2,
+        ccfg_old=ccfg_old2, ccfg_new=ccfg_new2, ccfg_cid=ccfg_cid2,
+        ccfg_epoch=ccfg_epoch2,
     )
     out = StepOutput(
         term=new_term2, role=role2, leader_id=leader_id2,
+        voted_term=new_voted_term, voted_for=new_voted_for,
         head=head2, apply=apply2, commit=commit2, end=end3,
         hb_seen=(has_msg & use).astype(i32),
         became_leader=became.astype(i32),

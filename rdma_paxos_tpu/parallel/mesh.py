@@ -44,7 +44,7 @@ def make_replica_mesh(n_replicas: int,
 def stack_states(cfg: LogConfig, n_replicas: int, group_size: int
                  ) -> ReplicaState:
     """Batched initial state: every leaf gains a leading replica axis."""
-    one = make_replica_state(cfg, group_size)
+    one = make_replica_state(cfg, group_size, n_replicas)
     return jax.tree.map(
         lambda x: jnp.broadcast_to(x, (n_replicas,) + x.shape), one)
 
@@ -59,7 +59,7 @@ def _unsqueeze(tree):
 
 def build_spmd_step(cfg: LogConfig, n_replicas: int, mesh: Mesh, *,
                     use_pallas: bool = False, interpret: bool = False,
-                    donate: bool = True):
+                    donate: bool = True, fanout: str = "gather"):
     """Compile the protocol step over a real device mesh.
 
     Takes/returns *batched* pytrees (leading ``replica`` axis, sharded one
@@ -70,7 +70,8 @@ def build_spmd_step(cfg: LogConfig, n_replicas: int, mesh: Mesh, *,
     """
     core = functools.partial(
         replica_step, cfg=cfg, n_replicas=n_replicas,
-        axis_name=REPLICA_AXIS, use_pallas=use_pallas, interpret=interpret)
+        axis_name=REPLICA_AXIS, use_pallas=use_pallas, interpret=interpret,
+        fanout=fanout)
 
     def per_device(state_b, inp_b):
         st, out = core(_squeeze(state_b), _squeeze(inp_b))
@@ -86,11 +87,12 @@ def build_spmd_step(cfg: LogConfig, n_replicas: int, mesh: Mesh, *,
 
 def build_sim_step(cfg: LogConfig, n_replicas: int, *,
                    use_pallas: bool = False, interpret: bool = False,
-                   donate: bool = True):
+                   donate: bool = True, fanout: str = "gather"):
     """Compile the protocol step as an N-replica simulation on one device
     (``vmap`` with a named axis — identical collective semantics)."""
     core = functools.partial(
         replica_step, cfg=cfg, n_replicas=n_replicas,
-        axis_name=REPLICA_AXIS, use_pallas=use_pallas, interpret=interpret)
+        axis_name=REPLICA_AXIS, use_pallas=use_pallas, interpret=interpret,
+        fanout=fanout)
     mapped = jax.vmap(core, in_axes=(0, 0), axis_name=REPLICA_AXIS)
     return jax.jit(mapped, donate_argnums=(0,) if donate else ())

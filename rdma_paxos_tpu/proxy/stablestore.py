@@ -9,6 +9,8 @@ import os
 import subprocess
 from typing import Optional
 
+import numpy as np
+
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__)))), "native")
 _LIB_PATH = os.path.join(_NATIVE_DIR, "libstablestore.so")
@@ -50,6 +52,47 @@ def _load() -> ctypes.CDLL:
     lib.ss_close.argtypes = [ctypes.c_void_p]
     _lib = lib
     return lib
+
+
+class HardState:
+    """Durable election state ``(term, voted_term, voted_for)``.
+
+    The reference makes votes durable by replicating them to a majority's
+    memory before acking (``rc_replicate_vote``, ``dare_ibv_rc.c:1049``)
+    and reading them back on recovery (``rc_get_replicated_vote``). Here
+    the device step replicates the pair to live peers' ``vote_rec_*``
+    state; this file is the host-side persistence layer the driver writes
+    between steps, so a crash-recovered replica restores
+    ``max(peer records, this file)`` and can never double-vote in a term.
+    Atomic: temp file + fsync + rename."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._last = None
+
+    def save(self, term: int, voted_term: int, voted_for: int) -> None:
+        tup = (int(term), int(voted_term), int(voted_for))
+        if tup == self._last:
+            return
+        tmp = self.path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(np.array(tup, "<i8").tobytes())
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+        self._last = tup
+
+    def load(self):
+        """-> (term, voted_term, voted_for) or None if absent/corrupt."""
+        try:
+            with open(self.path, "rb") as f:
+                b = f.read()
+        except FileNotFoundError:
+            return None
+        if len(b) != 24:
+            return None
+        t = np.frombuffer(b, "<i8")
+        return (int(t[0]), int(t[1]), int(t[2]))
 
 
 class StableStore:

@@ -30,10 +30,11 @@ import numpy as np
 from rdma_paxos_tpu.config import LogConfig, TimeoutConfig
 from rdma_paxos_tpu.consensus.log import EntryType
 from rdma_paxos_tpu.consensus.membership import MembershipManager
-from rdma_paxos_tpu.consensus.snapshot import install_snapshot, take_snapshot
+from rdma_paxos_tpu.consensus.snapshot import (
+    install_snapshot, recover_vote, take_snapshot)
 from rdma_paxos_tpu.consensus.state import ConfigState, Role
 from rdma_paxos_tpu.proxy.proxy import PendingEvent, ProxyServer, ReplayEngine
-from rdma_paxos_tpu.proxy.stablestore import StableStore
+from rdma_paxos_tpu.proxy.stablestore import HardState, StableStore
 from rdma_paxos_tpu.runtime.sim import SimCluster
 from rdma_paxos_tpu.runtime.timers import ElectionTimer, Pacer
 from rdma_paxos_tpu.utils.debug import ReplicaLog
@@ -58,6 +59,10 @@ class _ReplicaRuntime:
         self.replay = (ReplayEngine("127.0.0.1", app_port)
                        if app_port else None)
         self.store = StableStore(store_path) if store_path else None
+        # durable (term, voted_term, voted_for) — persisted every step the
+        # pair changes, restored by recover_replica (election safety
+        # across crashes; rc_replicate_vote/rc_get_replicated_vote analog)
+        self.hard = HardState(store_path + ".hs") if store_path else None
         # (event, last_fragment_seq) FIFO awaiting commit — every access
         # must hold the driver lock (link threads append, poll thread pops)
         self.inflight: collections.deque = collections.deque()
@@ -220,6 +225,10 @@ class ClusterDriver:
             self._leader_view = max(claims)[1] if claims else -1
 
         for r, rt in enumerate(self.runtimes):
+            if rt.hard is not None:
+                rt.hard.save(int(res["term"][r]),
+                             int(res["voted_term"][r]),
+                             int(res["voted_for"][r]))
             if res["became_leader"][r]:
                 rt.log.leader_elected(int(res["term"][r]))
             if res["hb_seen"][r] or res["role"][r] == int(Role.LEADER):
@@ -350,7 +359,19 @@ class ClusterDriver:
         drt, rrt = self.runtimes[donor], self.runtimes[r]
         blob = drt.store.dump() if drt.store else b""
         snap = take_snapshot(self.cluster.state, donor, blob)
-        self.cluster.state = install_snapshot(self.cluster.state, r, snap)
+        # restore election durability: newest vote among live peers'
+        # records (read BEFORE install wipes r's rows) and r's HardState
+        # file; current term floored at all of them
+        vt, vf = recover_vote(self.cluster.state, r)
+        hs = rrt.hard.load() if rrt.hard is not None else None
+        cur_term = 0
+        if hs is not None:
+            cur_term = hs[0]
+            if hs[1] > vt:
+                vt, vf = hs[1], hs[2]
+        self.cluster.state = install_snapshot(
+            self.cluster.state, r, snap,
+            voted_term=vt, voted_for=vf, cur_term=cur_term)
         self.cluster.applied[r] = snap.index
         rt_stream = self.cluster.replayed[r]
         rrt.replay_cursor = len(rt_stream)

@@ -50,7 +50,8 @@ class HostReplicaDriver:
     def __init__(self, cfg: LogConfig, *, process_id: int,
                  num_processes: int, coordinator: str,
                  group_size: Optional[int] = None,
-                 initialize_distributed: bool = True):
+                 initialize_distributed: bool = True,
+                 fanout: str = "psum"):
         if initialize_distributed:
             jax.distributed.initialize(
                 coordinator_address=coordinator,
@@ -64,7 +65,9 @@ class HostReplicaDriver:
                 f"need {self.R} global devices, have {len(devs)}")
         self.mesh = Mesh(np.array(devs[:self.R]), (REPLICA_AXIS,))
         self._sharding = NamedSharding(self.mesh, P(REPLICA_AXIS))
-        self._step = build_spmd_step(cfg, self.R, self.mesh)
+        # real deployments run full-connectivity meshes: the O(W) psum
+        # fan-out is sound there (see replica_step's fanout docstring)
+        self._step = build_spmd_step(cfg, self.R, self.mesh, fanout=fanout)
 
         def fetch(state_b, starts):
             def per_dev(log_b, start_b):
@@ -86,12 +89,44 @@ class HostReplicaDriver:
 
     # ------------------------------------------------------------------
 
-    def _global_from_local(self, local: np.ndarray) -> jax.Array:
+    def restore_hardstate(self, term: int, voted_term: int,
+                          voted_for: int) -> None:
+        """Install this host's persisted election state (HardState file)
+        into its replica's state row — election safety across restarts: a
+        recovered daemon must never re-grant a vote it already cast.
+        Collective: every host calls this at the same point (pass zeros
+        when it has no persisted state)."""
+        g = self._global_from_local(
+            np.array([term, voted_term, voted_for], np.int32))  # [R, 3]
+
+        @jax.jit
+        def upd(state, g):
+            return dataclasses.replace(
+                state,
+                term=jnp.maximum(state.term, g[:, 0]),
+                voted_for=jnp.where(g[:, 1] > state.voted_term,
+                                    g[:, 2], state.voted_for),
+                voted_term=jnp.maximum(state.voted_term, g[:, 1]),
+            )
+        self.state = upd(self.state, g)
+
+    def _global_from_local(self, local: np.ndarray,
+                           fill=0) -> jax.Array:
         """Build a [R, ...] global array where this host provides row
-        ``me`` (other rows come from the other hosts)."""
-        shard = jax.device_put(local[None], self._local_dev)
+        ``me`` (other rows come from the other hosts). When several mesh
+        devices are addressable by THIS process (single-process testing),
+        the extra rows are filled with the field's NEUTRAL value ``fill``
+        (0 = no input for batches/timeouts; peer_mask passes 1 — an
+        all-zero mask would make those replicas deaf, not idle)."""
+        shards = []
+        for d in self.mesh.devices.flat:
+            if d.process_index != jax.process_index():
+                continue
+            row = (local if d == self._local_dev
+                   else np.full_like(local, fill))
+            shards.append(jax.device_put(row[None], d))
         return jax.make_array_from_single_device_arrays(
-            (self.R,) + local.shape, self._sharding, [shard])
+            (self.R,) + local.shape, self._sharding, shards)
 
     def make_input(self, batch: Sequence[Tuple[int, int, int, bytes]] = (),
                    timeout_fired: bool = False,
@@ -115,7 +150,7 @@ class HostReplicaDriver:
                 np.asarray(min(len(batch), B), np.int32)),
             timeout_fired=self._global_from_local(
                 np.asarray(int(timeout_fired), np.int32)),
-            peer_mask=self._global_from_local(pm),
+            peer_mask=self._global_from_local(pm, fill=1),
             apply_done=self._global_from_local(
                 np.asarray(apply_done, np.int32)),
         )
@@ -126,7 +161,8 @@ class HostReplicaDriver:
         inp = self.make_input(**kw)
         self.state, out = self._step(self.state, inp)
         res = {}
-        for k in ("term", "role", "leader_id", "head", "apply", "commit",
+        for k in ("term", "role", "leader_id", "voted_term", "voted_for",
+                  "head", "apply", "commit",
                   "end", "hb_seen", "became_leader", "acked", "accepted",
                   "leadership_verified"):
             arr = getattr(out, k)

@@ -28,7 +28,7 @@ from rdma_paxos_tpu.consensus.log import (
     EntryType, M_CONN, M_LEN, M_REQID, M_TYPE)
 from rdma_paxos_tpu.consensus.state import Role
 from rdma_paxos_tpu.proxy.proxy import PendingEvent, ProxyServer, ReplayEngine
-from rdma_paxos_tpu.proxy.stablestore import StableStore
+from rdma_paxos_tpu.proxy.stablestore import HardState, StableStore
 from rdma_paxos_tpu.runtime.host import HostReplicaDriver
 from rdma_paxos_tpu.runtime.timers import ElectionTimer
 from rdma_paxos_tpu.utils.codec import fragment
@@ -61,6 +61,14 @@ class NodeDaemon:
                        if app_port else None)
         self.store = StableStore(
             os.path.join(workdir, f"replica{self.me}.db"))
+        self.hard = HardState(
+            os.path.join(workdir, f"replica{self.me}.db.hs"))
+        # a RESTARTED daemon restores its persisted election state so it
+        # cannot double-vote in a term it voted in before the crash
+        # (collective — every daemon calls this during init, with zeros
+        # when no prior state exists)
+        hs = self.hard.load()
+        self.hd.restore_hardstate(*(hs if hs is not None else (0, 0, -1)))
         self.log = ReplicaLog(
             os.path.join(workdir, f"replica{self.me}.log"))
         self.timer = ElectionTimer(timeout_cfg or TimeoutConfig(),
@@ -121,6 +129,8 @@ class NodeDaemon:
 
         res = self.hd.step(batch=batch, timeout_fired=fire,
                            apply_done=self.applied)
+        self.hard.save(int(res["term"]), int(res["voted_term"]),
+                       int(res["voted_for"]))
         was_leader = self._is_leader
         with self._lock:
             self._is_leader = int(res["role"]) == int(Role.LEADER)

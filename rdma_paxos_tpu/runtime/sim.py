@@ -37,19 +37,21 @@ class SimCluster:
 
     def __init__(self, cfg: LogConfig, n_replicas: int,
                  group_size: Optional[int] = None, *, mode: str = "sim",
-                 use_pallas: bool = False, interpret: bool = False):
+                 use_pallas: bool = False, interpret: bool = False,
+                 fanout: str = "gather"):
         self.cfg = cfg
         self.R = n_replicas
         self.group_size = group_size or n_replicas
         self.state = stack_states(cfg, n_replicas, self.group_size)
-        key = (cfg, n_replicas, mode, use_pallas, interpret)
+        key = (cfg, n_replicas, mode, use_pallas, interpret, fanout)
         cached = self._STEP_CACHE.get(key)
         if mode == "spmd":
             if cached is None:
                 mesh = make_replica_mesh(n_replicas)
                 cached = (build_spmd_step(cfg, n_replicas, mesh,
                                           use_pallas=use_pallas,
-                                          interpret=interpret), mesh)
+                                          interpret=interpret,
+                                          fanout=fanout), mesh)
                 self._STEP_CACHE[key] = cached
             self._step, self.mesh = cached
             self.state = jax.device_put(
@@ -60,7 +62,8 @@ class SimCluster:
             if cached is None:
                 cached = (build_sim_step(cfg, n_replicas,
                                          use_pallas=use_pallas,
-                                         interpret=interpret), None)
+                                         interpret=interpret,
+                                         fanout=fanout), None)
                 self._STEP_CACHE[key] = cached
             self._step = cached[0]
         self._fetch = jax.jit(
@@ -134,7 +137,8 @@ class SimCluster:
         inp = self._build_inputs(timeouts)
         self.state, out = self._step(self.state, inp)
         res = {k: np.asarray(getattr(out, k))
-               for k in ("term", "role", "leader_id", "head", "apply",
+               for k in ("term", "role", "leader_id", "voted_term",
+                         "voted_for", "head", "apply",
                          "commit", "end", "hb_seen", "became_leader",
                          "acked", "accepted", "peer_acked",
                          "leadership_verified")}

@@ -1,0 +1,193 @@
+"""Election durability and config rollback — the crash-safety properties
+the reference gets from vote replication (``rc_replicate_vote`` /
+``rc_get_replicated_vote``, ``dare_ibv_rc.c:1049-1109,394-473``) and Raft's
+fall-back-to-previous-configuration rule on log truncation."""
+
+import numpy as np
+
+from rdma_paxos_tpu.config import LogConfig
+from rdma_paxos_tpu.consensus.membership import MembershipManager
+from rdma_paxos_tpu.consensus.snapshot import (
+    install_snapshot, recover_vote, take_snapshot)
+from rdma_paxos_tpu.consensus.state import ConfigState, Role
+from rdma_paxos_tpu.proxy.stablestore import HardState
+from rdma_paxos_tpu.runtime.sim import SimCluster
+
+CFG = LogConfig(n_slots=64, slot_bytes=32, window_slots=16, batch_slots=8)
+
+
+def _elect_with_2_partitioned(c):
+    """Elect 0 with votes from {0, 2} while 1 is partitioned away, so 1
+    stays at term 0 and can later become a candidate for term 1."""
+    c.partition([[0, 2], [1]])
+    res = c.step(timeouts=[0])
+    assert res["role"][0] == int(Role.LEADER)
+    assert int(res["term"][0]) == 1
+    assert int(res["term"][1]) == 0
+    return res
+
+
+def test_peers_retain_vote_records():
+    c = SimCluster(CFG, 3)
+    _elect_with_2_partitioned(c)
+    # replica 0 and 2 voted for 0 in term 1; both live peers retain it
+    vt, vf = recover_vote(c.state, 2, peers=[0])
+    assert (vt, vf) == (1, 0)
+    vt, vf = recover_vote(c.state, 0, peers=[2])
+    assert (vt, vf) == (1, 0)
+    # partitioned replica 1 never voted
+    vt, vf = recover_vote(c.state, 1)
+    assert vt == 0
+
+
+def test_recovered_replica_cannot_double_vote():
+    """A crash-recovered replica restores its vote from peers' records:
+    it must NOT grant a second vote in a term it already voted in
+    (election safety: at most one leader per term)."""
+    c = SimCluster(CFG, 3)
+    _elect_with_2_partitioned(c)
+
+    # crash replica 2; recover from leader snapshot + peer vote records
+    snap = take_snapshot(c.state, donor=0)
+    vt, vf = recover_vote(c.state, 2, peers=[0])
+    c.state = install_snapshot(c.state, 2, snap, voted_term=vt,
+                               voted_for=vf)
+
+    # 1 (still at term 0) campaigns for term 1 with only {1, 2} reachable:
+    # 2 already voted for 0 in term 1 and must refuse
+    c.partition([[1, 2], [0]])
+    res = c.step(timeouts=[1])
+    assert res["role"][1] != int(Role.LEADER), (
+        "replica 2 double-voted in term 1 — two leaders in one term")
+
+
+def test_unrestored_vote_would_double_vote():
+    """Control for the test above: WITHOUT vote restoration the same
+    scenario elects a second term-1 leader — proving the restored vote is
+    what provides the safety."""
+    c = SimCluster(CFG, 3)
+    _elect_with_2_partitioned(c)
+    snap = take_snapshot(c.state, donor=0)
+    c.state = install_snapshot(c.state, 2, snap)   # vote NOT restored
+    c.partition([[1, 2], [0]])
+    res = c.step(timeouts=[1])
+    assert res["role"][1] == int(Role.LEADER), (
+        "scenario no longer exercises the double-vote hazard")
+
+
+def test_hardstate_roundtrip(tmp_path):
+    hs = HardState(str(tmp_path / "r0.hs"))
+    assert hs.load() is None
+    hs.save(3, 3, 1)
+    assert hs.load() == (3, 3, 1)
+    hs.save(5, 4, 2)
+    fresh = HardState(str(tmp_path / "r0.hs"))
+    assert fresh.load() == (5, 4, 2)
+
+
+def test_install_floors_term_at_recovered_vote():
+    c = SimCluster(CFG, 3)
+    _elect_with_2_partitioned(c)
+    snap = take_snapshot(c.state, donor=0)
+    c.state = install_snapshot(c.state, 2, snap, voted_term=7,
+                               voted_for=0, cur_term=5)
+    assert int(np.asarray(c.state.term[2])) == 7
+    assert int(np.asarray(c.state.voted_term[2])) == 7
+    assert int(np.asarray(c.state.voted_for[2])) == 0
+
+
+def test_host_driver_restores_hardstate():
+    """A restarted NodeDaemon restores (term, voted_term, voted_for) from
+    its HardState file into its replica row before stepping (the
+    multi-host analog of ClusterDriver._do_recover's restore)."""
+    from rdma_paxos_tpu.runtime.host import HostReplicaDriver
+    hd = HostReplicaDriver(CFG, process_id=0, num_processes=3,
+                           coordinator="", initialize_distributed=False)
+    hd.restore_hardstate(4, 4, 1)
+    assert int(np.asarray(hd.state.term[0])) == 4
+    assert int(np.asarray(hd.state.voted_term[0])) == 4
+    assert int(np.asarray(hd.state.voted_for[0])) == 1
+    # stale persisted state never regresses newer in-memory state
+    hd.restore_hardstate(2, 2, 0)
+    assert int(np.asarray(hd.state.term[0])) == 4
+    assert int(np.asarray(hd.state.voted_for[0])) == 1
+    # the cluster is live after restore: a campaign from the restored
+    # replica runs at term 5 (> restored term 4) and the other replicas
+    # hear and grant — exercising that single-process padding rows are
+    # neutral (peer_mask ones), not deaf
+    res = hd.step(timeout_fired=True)
+    assert int(res["role"]) == int(Role.LEADER)
+    assert int(res["term"]) == 5
+
+
+def test_truncated_config_rolls_back():
+    """An adopted-but-uncommitted CONFIG entry that is truncated by the
+    divergence rule must stop governing the replica: the config reverts
+    to the newest surviving configuration (Raft's fall-back rule). The
+    reference's incremental poll_config_entries cannot revert; the
+    derive-from-log scan here does."""
+    c = SimCluster(CFG, 8, group_size=3)
+    mm = MembershipManager(c)
+    c.run_until_elected(0)
+    c.step()
+    base = mm.current(0)
+    assert base["bitmask_new"] == 0b111
+
+    # leader 0 is partitioned alone, appends a TRANSIT config locally —
+    # adopted immediately (append-time rule) but never replicated
+    c.partition([[0], [1, 2]])
+    mm.submit_transit(0, 0b111, 0b11111, epoch=1)
+    c.step()
+    assert mm.current(0)["cid_state"] == int(ConfigState.TRANSIT)
+    assert mm.current(0)["bitmask_new"] == 0b11111
+
+    # meanwhile 1 wins a higher-term election and appends entries
+    res = c.step(timeouts=[1])
+    assert res["role"][1] == int(Role.LEADER)
+    c.submit(1, b"overwrite")
+    c.step()
+
+    # heal: 0 absorbs the higher-term window, its uncommitted CONFIG is
+    # truncated -> config must roll back to the stable base config
+    c.heal()
+    for _ in range(4):
+        res = c.step()
+    cur = mm.current(0)
+    assert cur["bitmask_new"] == 0b111, (
+        "truncated CONFIG still governs replica 0")
+    assert cur["cid_state"] == int(ConfigState.STABLE)
+    assert cur["epoch"] == base["epoch"]
+    # and the cluster still functions under the rolled-back config
+    c.submit(1, b"after-rollback")
+    res = c.step()
+    res = c.step()
+    assert int(res["commit"][1]) == int(res["end"][1])
+
+
+def test_committed_config_survives_pruning():
+    """Once a CONFIG entry commits, its config must keep governing even
+    after the entry is pruned from the ring (checkpoint fallback)."""
+    small = LogConfig(n_slots=16, slot_bytes=32, window_slots=8,
+                      batch_slots=4)
+    c = SimCluster(small, 5, group_size=3)
+    mm = MembershipManager(c)
+    c.run_until_elected(0)
+    mm.change(0, 0b11111)          # commit an upsize to 5
+    assert mm.current(0)["bitmask_new"] == 0b11111
+    # flood the tiny ring so pruning advances head past the CONFIG entries
+    for i in range(40):
+        c.submit(0, b"x%d" % i)
+        c.step()
+    for _ in range(4):
+        res = c.step()
+    head = int(res["head"][0])
+    assert head > 0, "ring never pruned"
+    cur = mm.current(0)
+    assert cur["bitmask_new"] == 0b11111, (
+        "config lost when its entry was pruned")
+    # quorum is still 3-of-5
+    c.partition([[0, 1, 2], [3], [4]])
+    c.submit(0, b"still-5")
+    res = c.step()
+    assert int(res["commit"][0]) == int(res["end"][0])
+    c.heal()

@@ -28,6 +28,31 @@ def test_spmd_replication_8_replicas():
         assert [p for (_, _, _, p) in c.replayed[r]] == [b"spmd!"]
 
 
+def test_psum_fanout_matches_gather():
+    """The O(W) psum window broadcast must be observably identical to the
+    O(R·W) gather-select fan-out under full connectivity (the only regime
+    it is specified for): same commits, same replayed bytes, same log."""
+    runs = {}
+    for fo in ("gather", "psum"):
+        c = SimCluster(CFG, 5, fanout=fo)
+        c.run_until_elected(0)
+        for i in range(6):
+            c.submit(0, b"op-%d" % i)
+            c.step()
+        # leadership churn under full connectivity: new leader takes over
+        c.step(timeouts=[2])
+        c.submit(2, b"after-churn")
+        for _ in range(3):
+            res = c.step()
+        runs[fo] = (res, c.replayed, np.asarray(c.state.log.buf))
+    rg, replg, bufg = runs["gather"]
+    rp, replp, bufp = runs["psum"]
+    for k in ("term", "role", "commit", "end", "head"):
+        assert list(rg[k]) == list(rp[k]), k
+    assert replg == replp
+    assert (bufg == bufp).all()
+
+
 def test_spmd_group3_with_learners():
     """Mesh bigger than the voting group: replicas outside the membership
     bitmask are learners — they absorb the log but neither vote nor count
