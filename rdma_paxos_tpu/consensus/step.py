@@ -165,6 +165,7 @@ def replica_step(
     use_pallas: bool = False,
     interpret: bool = False,
     fanout: str = "gather",
+    elections: bool = True,
 ) -> Tuple[ReplicaState, StepOutput]:
     """One protocol step for this replica (call under ``shard_map`` over the
     ``replica`` mesh axis, or under ``vmap(axis_name=...)`` for single-chip
@@ -192,6 +193,16 @@ def replica_step(
       even a violated assumption degrades to a rejected window, not a
       corrupted log... except the summed payload itself; hence the
       partition-capable paths (SimCluster default, fuzzer) keep "gather".
+
+    ``elections=False`` compiles the STABLE fast-path step: Phase B (one
+    collective + the candidacy/vote logic) is statically removed. With no
+    ``timeout_fired`` input set, the full step and the stable step compute
+    bit-identical results — candidacies are the only thing Phase B can
+    change — so a driver may freely dispatch the stable step on every
+    iteration where no election timer fired (the latency hot path) and
+    the full step otherwise. Term adoption from the control gather and
+    window absorption still run, so a deposed leader steps down and a
+    higher-term leader is followed even in stable steps.
     """
     assert fanout in ("gather", "psum"), fanout
     i32 = jnp.int32
@@ -231,10 +242,31 @@ def replica_step(
     # ------------------------------------------------------------------
     # Phase B — one-round election (start_election dare_server.c:1264,
     # voting :1526-1743, counting :1327-1518 — collapsed to one step).
+    # Statically removed in the stable fast path (elections=False).
     # ------------------------------------------------------------------
-    is_cand = (g_tmo > 0) & (in_new > 0)                    # [R]
-    cand_term = g_term + 1
-    i_cand = is_cand[me] & (state.role != int(Role.LEADER))
+    if not elections:
+        new_voted_term = state.voted_term
+        new_voted_for = state.voted_for
+        vote_rec_term2 = state.vote_rec_term
+        vote_rec_for2 = state.vote_rec_for
+        win = jnp.zeros((), bool)
+        became = jnp.zeros((), bool)
+        max_heard = jnp.max(jnp.where(heard, g_term, I32_MIN))
+        new_term = jnp.maximum(state.term, max_heard)
+        role = jnp.where(new_term > state.term, int(Role.FOLLOWER),
+                         state.role).astype(i32)
+        i_lead = role == int(Role.LEADER)
+        leader_id = jnp.where(new_term > state.term, -1,
+                              state.leader_id).astype(i32)
+        log2, end2 = append_batch(
+            state.log, state.end, state.head, inp.batch_data,
+            inp.batch_meta,
+            jnp.where(i_lead, inp.batch_count, 0).astype(i32), new_term)
+        end1 = state.end
+    else:
+        is_cand = (g_tmo > 0) & (in_new > 0)                # [R]
+        cand_term = g_term + 1
+        i_cand = is_cand[me] & (state.role != int(Role.LEADER))
 
     # voter logic (vote durability: the vote all_gather below replicates
     # the durable (voted_term, voted_for) pair to every live peer, which

@@ -201,19 +201,27 @@ class ClusterDriver:
                                         conn=conn, req_id=seq)
                 self._submitq[r].clear()
 
-        timeouts = []
-        last = self.cluster.last
-        for r, rt in enumerate(self.runtimes):
-            if last is not None and last["role"][r] == int(Role.LEADER):
-                continue
-            if rt.timer.expired():
-                timeouts.append(r)
-                rt.timer.beat()
-                rt.fired_leader = (int(last["leader_id"][r])
-                                   if last is not None else -1)
-                rt.fired_countdown = 50
-
-        res = self.cluster.step(timeouts=timeouts)
+        # deep submit queue + known leader: drain through a multi-step
+        # burst (one dispatch for up to K_TIERS[-1] protocol steps; no
+        # election timeouts can fire inside — each burst step carries the
+        # heartbeat, so follower timers are beaten right after)
+        if (self._leader_view >= 0 and self.cluster.last is not None
+                and max(len(q) for q in self.cluster.pending)
+                > self.cfg.batch_slots):
+            res = self.cluster.step_burst()
+        else:
+            timeouts = []
+            last = self.cluster.last
+            for r, rt in enumerate(self.runtimes):
+                if last is not None and last["role"][r] == int(Role.LEADER):
+                    continue
+                if rt.timer.expired():
+                    timeouts.append(r)
+                    rt.timer.beat()
+                    rt.fired_leader = (int(last["leader_id"][r])
+                                       if last is not None else -1)
+                    rt.fired_countdown = 50
+            res = self.cluster.step(timeouts=timeouts)
 
         with self._lock:
             # multiple self-claimed leaders can coexist transiently (an
@@ -420,12 +428,18 @@ class ClusterDriver:
     def run(self, period: float = 0.0) -> None:
         """Run the polling loop in a background thread, paced at
         ``period`` (the hb_period cadence — each step carries the
-        heartbeat)."""
+        heartbeat). Pacing is adaptive: while client work is pending or
+        blocked app threads await commit, the loop free-runs (the
+        reference's busy commit loop); it only sleeps when idle."""
         def loop():
             pacer = Pacer(period) if period else None
             while not self._stop.is_set():
                 self.step()
-                if pacer is not None:
+                with self._lock:
+                    busy = (any(self._submitq)
+                            or any(len(q) for q in self.cluster.pending)
+                            or any(rt.inflight for rt in self.runtimes))
+                if pacer is not None and not busy:
                     pacer.wait()
         self._thread = threading.Thread(target=loop, daemon=True)
         self._thread.start()

@@ -24,7 +24,8 @@ from rdma_paxos_tpu.consensus.log import (
 from rdma_paxos_tpu.consensus.state import Role
 from rdma_paxos_tpu.consensus.step import StepInput, fetch_window
 from rdma_paxos_tpu.parallel.mesh import (
-    build_sim_step, build_spmd_step, make_replica_mesh, stack_states)
+    build_sim_burst, build_sim_step, build_spmd_burst, build_spmd_step,
+    make_replica_mesh, stack_states)
 from rdma_paxos_tpu.utils.codec import bytes_to_words
 
 
@@ -42,6 +43,10 @@ class SimCluster:
         self.cfg = cfg
         self.R = n_replicas
         self.group_size = group_size or n_replicas
+        self._mode = mode
+        self._use_pallas = use_pallas
+        self._interpret = interpret
+        self._fanout = fanout
         self.state = stack_states(cfg, n_replicas, self.group_size)
         key = (cfg, n_replicas, mode, use_pallas, interpret, fanout)
         cached = self._STEP_CACHE.get(key)
@@ -132,6 +137,88 @@ class SimCluster:
             peer_mask=jnp.asarray(self.peer_mask),
             apply_done=jnp.asarray(self.applied.astype(np.int32)),
         )
+
+    # burst size tiers: the smallest tier >= the steps needed is compiled
+    # (bounded recompiles) and padded with zero-count steps
+    K_TIERS = (2, 4, 8, 16)
+
+    def _burst_fn(self, K: int):
+        key = (self.cfg, self.R, self._mode, self._use_pallas,
+               self._interpret, self._fanout, "burst", K)
+        fn = self._STEP_CACHE.get(key)
+        if fn is None:
+            if self._mode == "spmd":
+                fn = build_spmd_burst(self.cfg, self.R, self.mesh,
+                                      use_pallas=self._use_pallas,
+                                      interpret=self._interpret,
+                                      fanout=self._fanout)
+            else:
+                fn = build_sim_burst(self.cfg, self.R,
+                                     use_pallas=self._use_pallas,
+                                     interpret=self._interpret,
+                                     fanout=self._fanout)
+            self._STEP_CACHE[key] = fn
+        return fn
+
+    def step_burst(self) -> Dict[str, np.ndarray]:
+        """Drain the pending queues through up to ``max(K_TIERS)`` fused
+        protocol steps in ONE device dispatch (multi-step driver mode —
+        the host-side analog of the reference's busy commit loop). No
+        election timeouts fire inside the burst; the caller must only
+        burst while a leader is known. Returns the final step's outputs
+        (``accepted`` aggregated over the burst)."""
+        cfg, R, B = self.cfg, self.R, self.cfg.batch_slots
+        assert self.last is not None, "burst requires a stepped cluster"
+        # capacity sizing: never enqueue more than the ring can take
+        # without drops, so mid-burst drops (which would reorder a
+        # connection's fragments against later steps) cannot occur
+        take_n = []
+        for r in range(R):
+            avail = (cfg.n_slots - 1) - (int(self.last["end"][r])
+                                         - int(self.last["head"][r]))
+            take_n.append(min(len(self.pending[r]), max(avail, 0),
+                              self.K_TIERS[-1] * B))
+        k_needed = max(1, max(-(-n // B) for n in take_n))
+        K = next(k for k in self.K_TIERS if k >= k_needed)
+
+        data = np.zeros((K, R, B, cfg.slot_words), np.int32)
+        meta = np.zeros((K, R, B, META_W), np.int32)
+        count = np.zeros((K, R), np.int32)
+        taken: List[List[Tuple[int, int, int, bytes]]] = []
+        for r in range(R):
+            take = self.pending[r][:take_n[r]]
+            self.pending[r] = self.pending[r][take_n[r]:]
+            taken.append(take)
+            for i, (t, conn, req, payload) in enumerate(take):
+                k, j = divmod(i, B)
+                data[k, r, j] = bytes_to_words(payload, cfg.slot_words)
+                meta[k, r, j, M_TYPE] = t
+                meta[k, r, j, M_CONN] = conn
+                meta[k, r, j, M_REQID] = req
+                meta[k, r, j, M_LEN] = len(payload)
+            for k in range(K):
+                count[k, r] = max(0, min(take_n[r] - k * B, B))
+
+        fn = self._burst_fn(K)
+        self.state, outs = fn(self.state, jnp.asarray(data),
+                              jnp.asarray(meta), jnp.asarray(count),
+                              jnp.asarray(self.peer_mask))
+        res = {k: np.asarray(getattr(outs, k))[-1]
+               for k in ("term", "role", "leader_id", "voted_term",
+                         "voted_for", "head", "apply", "commit", "end",
+                         "hb_seen", "became_leader", "acked",
+                         "peer_acked", "leadership_verified")}
+        acc = np.asarray(outs.accepted).sum(axis=0)         # [R]
+        res["accepted"] = acc
+        for r in range(R):
+            if taken[r] and res["role"][r] == int(Role.LEADER):
+                if int(acc[r]) < len(taken[r]):
+                    raise AssertionError(
+                        f"burst dropped entries on leader {r}: "
+                        f"{acc[r]} < {len(taken[r])} despite sizing")
+        self._replay_committed(res)
+        self.last = res
+        return res
 
     def step(self, timeouts: Sequence[int] = ()) -> Dict[str, np.ndarray]:
         inp = self._build_inputs(timeouts)
