@@ -43,9 +43,19 @@ from rdma_paxos_tpu.config import LogConfig
 
 
 class EntryType(enum.IntEnum):
-    """Log entry types — reference ``dare_log.h:22-25`` (NOOP/CSM/CONFIG/HEAD)
+    """Log entry types — reference ``dare_log.h:22-25`` (NOOP/CSM/CONFIG)
     plus proxy event types carried in CSM entries (CONNECT/SEND/CLOSE,
-    reference ``src/include/dare/message.h``)."""
+    reference ``src/include/dare/message.h``).
+
+    The reference's fourth type, HEAD (``dare_log.h:25`` — a durable log
+    entry publishing the pruned head offset, ``log_pruning``
+    ``dare_server.c:1996-2067``), has NO analog here by design: the head
+    offset rides EVERY leader window message as a scalar column
+    (``S_HEAD``, consensus/step.py Phase D/E), so followers learn head
+    advancement continuously instead of through an in-log record, and a
+    restarted replica recovers head from its snapshot determinant
+    (consensus/snapshot.py). A durable in-log HEAD entry would be
+    redundant state with no consumer."""
 
     EMPTY = 0       # unwritten slot
     NOOP = 1        # blank entry appended by a fresh leader (dare_server.c:1487)
@@ -53,7 +63,6 @@ class EntryType(enum.IntEnum):
     SEND = 3        # proxy: client payload bytes      (proxy.c:230-239)
     CLOSE = 4       # proxy: connection closed         (proxy.c:241-261)
     CONFIG = 5      # membership change                (dare_log.h:24)
-    HEAD = 6        # log-pruning head advancement     (dare_log.h:25)
 
 
 # Metadata columns (SoA): meta[slot, col]. M_GIDX is the entry's global
@@ -62,6 +71,15 @@ class EntryType(enum.IntEnum):
 # e.g. the CONFIG-derivation scan in consensus/step.py. A recycled slot's
 # stale gidx is always < head (the ring holds <= n_slots live entries), so
 # `gidx >= head` alone identifies liveness.
+#
+# DESIGN CONSTRAINT: all log offsets (head/apply/commit/end and M_GIDX)
+# are i32 entry indices, so a deployment is bounded at 2^31-1 entries
+# (~13 minutes at the benched multi-M ops/s). The epoch-rebase path
+# already exists: snapshot install renumbers offsets from the snapshot
+# index (consensus/snapshot.py), so a long-running cluster rolls over by
+# a coordinated snapshot+install well before the ceiling — the same
+# mechanism a joiner uses. The reference has the analogous bound in its
+# uint64 byte offsets (dare_log.h:77-103), just further away.
 M_TYPE, M_TERM, M_CONN, M_REQID, M_LEN, M_GIDX = 0, 1, 2, 3, 4, 5
 META_W = 8  # padded for alignment
 

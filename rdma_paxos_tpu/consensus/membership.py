@@ -68,6 +68,62 @@ class MembershipManager:
                                 epoch + 2, max_steps)
         del target
 
+    def join(self, leader: int, joiner: int, *,
+             max_steps: int = 50) -> None:
+        """Three-phase joiner admission: EXTENDED (joiner replicates,
+        old quorum) → TRANSIT (dual quorum) → STABLE — the full
+        reference join ladder (``handle_server_join_request`` →
+        ``apply_committed_entries`` EXTENDED→TRANSIT→STABLE,
+        ``dare_server.c:1861-1937``). Blocking; the driver integration
+        drives the same phases incrementally."""
+        cur = self.current(leader)
+        old_mask = cur["bitmask_new"]
+        if (old_mask >> joiner) & 1:
+            return
+        epoch = cur["epoch"]
+        self.submit_extended(leader, old_mask, joiner, epoch + 1)
+        self._step_until_config(leader, int(ConfigState.EXTENDED),
+                                epoch + 1, max_steps)
+        # EXTENDED committed ⟹ the joiner is inside the replication
+        # window fan-out; it must actually CATCH UP before it may count
+        # toward quorum (a joiner whose lag exceeds window_slots can
+        # never catch up passively — it needs snapshot recovery first,
+        # exactly the reference's joiner SM-recovery prerequisite,
+        # dare_ibv_rc.c:603-710)
+        for _ in range(max_steps):
+            st = self.cluster.state
+            if (int(np.asarray(st.end[joiner]))
+                    >= int(np.asarray(st.end[leader]))):
+                break
+            self.cluster.step()
+        else:
+            raise TimeoutError(
+                f"joiner {joiner} did not catch up within {max_steps} "
+                "steps (lag beyond window_slots requires snapshot "
+                "recovery before join)")
+        # joiner caught up: flip to dual quorum
+        self.submit_transit(leader, old_mask, old_mask | (1 << joiner),
+                            epoch + 2)
+        self._step_until_config(leader, int(ConfigState.TRANSIT),
+                                epoch + 2, max_steps)
+        self.submit_stable(leader, old_mask | (1 << joiner), epoch + 3)
+        self._step_until_config(leader, int(ConfigState.STABLE),
+                                epoch + 3, max_steps)
+
+    def submit_extended(self, leader: int, old_mask: int, joiner: int,
+                        epoch: int) -> None:
+        """Announce an up-size for ``joiner`` (EXTENDED): the joiner is
+        added to ``bitmask_new`` so it receives the replication window
+        and counts in the pruning floor, but quorum stays on
+        ``bitmask_old`` until the leader submits TRANSIT — the
+        reference's EXTENDED config (``dare_ibv_ud.c:1024-1037``)."""
+        from rdma_paxos_tpu.consensus.log import EntryType
+        self.cluster.submit(
+            leader,
+            config_payload(old_mask, old_mask | (1 << joiner),
+                           int(ConfigState.EXTENDED), epoch),
+            EntryType.CONFIG)
+
     def submit_transit(self, leader: int, old_mask: int, new_mask: int,
                        epoch: int) -> None:
         from rdma_paxos_tpu.consensus.log import EntryType

@@ -76,8 +76,11 @@ from rdma_paxos_tpu.ops.quorum import R_PAD, commit_scan
 I32_MIN = jnp.iinfo(jnp.int32).min
 I32_MAX = jnp.iinfo(jnp.int32).max
 
-# control-gather columns
-C_TERM, C_ROLE, C_END, C_COMMIT, C_LTERM, C_APPLY, C_TMO, C_N = range(8)
+# control-gather columns (C_VTERM/C_VFOR carry each replica's durable vote
+# pair so vote records refresh on EVERY step — full or stable — not only
+# through the election-phase vote gather)
+(C_TERM, C_ROLE, C_END, C_COMMIT, C_LTERM, C_APPLY, C_TMO,
+ C_VTERM, C_VFOR, C_N) = range(10)
 # window-message scalar columns
 S_VALID, S_WSTART, S_WCOUNT, S_TERM, S_PREV, S_COMMIT, S_HEAD, S_N = range(8)
 
@@ -212,12 +215,22 @@ def replica_step(
 
     in_new = _popcount_vec(state.bitmask_new, R)            # [R] 0/1
     in_old = _popcount_vec(state.bitmask_old, R)
-    maj_new = jnp.sum(in_new) // 2 + 1
-    maj_old = jnp.sum(in_old) // 2 + 1
     transit = (state.cid_state == int(ConfigState.TRANSIT)).astype(i32)
+    # EXTENDED: the group was up-sized for a joiner that REPLICATES (it is
+    # in bitmask_new, so the window fan-out and pruning floor include it)
+    # but does not yet VOTE or count toward commit — quorum stays on the
+    # old config until the joiner has caught up and the leader submits
+    # TRANSIT (reference EXTENDED semantics: handle_server_join_request
+    # up-sizes via an EXTENDED config, dare_ibv_ud.c:1024-1037, and the
+    # joiner only joins quorums after EXTENDED→TRANSIT,
+    # dare_server.c:1861-1937).
+    ext = state.cid_state == int(ConfigState.EXTENDED)
+    in_vote = jnp.where(ext, in_old, in_new)                # voting members
+    maj_vote = jnp.sum(in_vote) // 2 + 1
+    maj_old = jnp.sum(in_old) // 2 + 1
     # During joint consensus, old-config members must still vote (the win
     # condition demands a majority of BOTH configs — dare_server.c:1366-1373)
-    i_member = (in_new[me] > 0) | ((transit > 0) & (in_old[me] > 0))
+    i_member = (in_vote[me] > 0) | ((transit > 0) & (in_old[me] > 0))
     my_lterm = last_term(state.log, state.end)
 
     # ------------------------------------------------------------------
@@ -233,11 +246,23 @@ def replica_step(
     ctrl = ctrl.at[C_LTERM].set(my_lterm)
     ctrl = ctrl.at[C_APPLY].set(jnp.minimum(inp.apply_done, state.commit))
     ctrl = ctrl.at[C_TMO].set(inp.timeout_fired)
+    ctrl = ctrl.at[C_VTERM].set(state.voted_term)
+    ctrl = ctrl.at[C_VFOR].set(state.voted_for)
     allc = lax.all_gather(ctrl, axis_name)                  # [R, C_N]
 
     g_term, g_end = allc[:, C_TERM], allc[:, C_END]
     g_lterm, g_apply = allc[:, C_LTERM], allc[:, C_APPLY]
     g_tmo = allc[:, C_TMO]
+
+    # vote-record retention from the control gather (rc_replicate_vote
+    # analog, dare_ibv_rc.c:1049): runs on EVERY step, so a replica that
+    # was partitioned during an election still learns peers' durable vote
+    # pairs once healed — identically in the full and stable paths.
+    rec_upd0 = heard & (allc[:, C_VTERM] > state.vote_rec_term)
+    vote_rec_term1 = jnp.where(rec_upd0, allc[:, C_VTERM],
+                               state.vote_rec_term)
+    vote_rec_for1 = jnp.where(rec_upd0, allc[:, C_VFOR],
+                              state.vote_rec_for)
 
     # ------------------------------------------------------------------
     # Phase B — one-round election (start_election dare_server.c:1264,
@@ -247,8 +272,8 @@ def replica_step(
     if not elections:
         new_voted_term = state.voted_term
         new_voted_for = state.voted_for
-        vote_rec_term2 = state.vote_rec_term
-        vote_rec_for2 = state.vote_rec_for
+        vote_rec_term2 = vote_rec_term1
+        vote_rec_for2 = vote_rec_for1
         win = jnp.zeros((), bool)
         became = jnp.zeros((), bool)
         max_heard = jnp.max(jnp.where(heard, g_term, I32_MIN))
@@ -264,82 +289,84 @@ def replica_step(
             jnp.where(i_lead, inp.batch_count, 0).astype(i32), new_term)
         end1 = state.end
     else:
-        is_cand = (g_tmo > 0) & (in_new > 0)                # [R]
+        is_cand = (g_tmo > 0) & (in_vote > 0)               # [R]
         cand_term = g_term + 1
         i_cand = is_cand[me] & (state.role != int(Role.LEADER))
 
-    # voter logic (vote durability: the vote all_gather below replicates
-    # the durable (voted_term, voted_for) pair to every live peer, which
-    # RETAINS it in vote_rec_* — the rc_replicate_vote analog; the host
-    # additionally persists the pair to a HardState file between steps,
-    # and recovery restores max(persisted, peer records) — see
-    # consensus/snapshot.py recover_vote)
-    can_grant = (
-        heard & is_cand
-        & (cand_term >= state.term)
-        & ((cand_term > state.voted_term)
-           | ((cand_term == state.voted_term)
-              & (jnp.arange(R) == state.voted_for)))
-        & ((g_lterm > my_lterm)
-           | ((g_lterm == my_lterm) & (g_end >= state.end)))
-    )
-    best = _lex_argmax(can_grant, [cand_term, g_lterm, g_end])
-    my_vote = jnp.where(i_cand, me, jnp.where(i_member, best, -1))
-    vote_cast = my_vote >= 0
-    new_voted_term = jnp.where(
-        vote_cast, jnp.maximum(state.voted_term, cand_term[my_vote]),
-        state.voted_term)
-    new_voted_for = jnp.where(vote_cast, my_vote, state.voted_for)
+        # voter logic (vote durability: the vote all_gather below
+        # replicates the durable (voted_term, voted_for) pair to every
+        # live peer, which RETAINS it in vote_rec_* — the
+        # rc_replicate_vote analog; the host additionally persists the
+        # pair to a HardState file between steps, and recovery restores
+        # max(persisted, peer records) — see consensus/snapshot.py
+        # recover_vote)
+        can_grant = (
+            heard & is_cand
+            & (cand_term >= state.term)
+            & ((cand_term > state.voted_term)
+               | ((cand_term == state.voted_term)
+                  & (jnp.arange(R) == state.voted_for)))
+            & ((g_lterm > my_lterm)
+               | ((g_lterm == my_lterm) & (g_end >= state.end)))
+        )
+        best = _lex_argmax(can_grant, [cand_term, g_lterm, g_end])
+        my_vote = jnp.where(i_cand, me, jnp.where(i_member, best, -1))
+        vote_cast = my_vote >= 0
+        new_voted_term = jnp.where(
+            vote_cast, jnp.maximum(state.voted_term, cand_term[my_vote]),
+            state.voted_term)
+        new_voted_for = jnp.where(vote_cast, my_vote, state.voted_for)
 
-    vote_msg = jnp.stack([my_vote, new_voted_term, new_voted_for])
-    g_votes = lax.all_gather(vote_msg, axis_name)           # [R, 3]
-    votes = g_votes[:, 0]
-    got = (votes == me) & heard
-    # retain every peer's newest durable vote pair (rc_replicate_vote
-    # analog) so a crash-recovered peer can read its vote back from us
-    rec_upd = heard & (g_votes[:, 1] > state.vote_rec_term)
-    vote_rec_term2 = jnp.where(rec_upd, g_votes[:, 1], state.vote_rec_term)
-    vote_rec_for2 = jnp.where(rec_upd, g_votes[:, 2], state.vote_rec_for)
-    win = (
-        i_cand
-        & (jnp.sum(got.astype(i32) * in_new) >= maj_new)
-        & jnp.where(transit > 0,
-                    jnp.sum(got.astype(i32) * in_old) >= maj_old, True)
-    )
+        vote_msg = jnp.stack([my_vote, new_voted_term, new_voted_for])
+        g_votes = lax.all_gather(vote_msg, axis_name)       # [R, 3]
+        votes = g_votes[:, 0]
+        got = (votes == me) & heard
+        # retain votes CAST THIS STEP immediately (the control-gather
+        # retention above only carries pre-step pairs): the vote gather
+        # doubles as same-step durable replication to every live peer
+        rec_upd = heard & (g_votes[:, 1] > vote_rec_term1)
+        vote_rec_term2 = jnp.where(rec_upd, g_votes[:, 1], vote_rec_term1)
+        vote_rec_for2 = jnp.where(rec_upd, g_votes[:, 2], vote_rec_for1)
+        win = (
+            i_cand
+            & (jnp.sum(got.astype(i32) * in_vote) >= maj_vote)
+            & jnp.where(transit > 0,
+                        jnp.sum(got.astype(i32) * in_old) >= maj_old, True)
+        )
 
-    # term adoption: everyone adopts the max term heard (incl. candidacies);
-    # a deposed leader steps down here — the fencing of server_to_follower
-    # (dare_server.c:2238).
-    my_term1 = jnp.where(i_cand, state.term + 1, state.term)
-    eff_term = jnp.where(is_cand, cand_term, g_term)
-    max_heard = jnp.max(jnp.where(heard, eff_term, I32_MIN))
-    new_term = jnp.maximum(my_term1, max_heard)
+        # term adoption: everyone adopts the max term heard (incl.
+        # candidacies); a deposed leader steps down here — the fencing of
+        # server_to_follower (dare_server.c:2238).
+        my_term1 = jnp.where(i_cand, state.term + 1, state.term)
+        eff_term = jnp.where(is_cand, cand_term, g_term)
+        max_heard = jnp.max(jnp.where(heard, eff_term, I32_MIN))
+        new_term = jnp.maximum(my_term1, max_heard)
 
-    role = jnp.where(
-        win, int(Role.LEADER),
-        jnp.where(new_term > my_term1, int(Role.FOLLOWER),
-                  jnp.where(i_cand, int(Role.CANDIDATE), state.role)),
-    ).astype(i32)
-    became = win & (state.role != int(Role.LEADER))
-    i_lead = role == int(Role.LEADER)
-    leader_id = jnp.where(win, me,
-                          jnp.where(new_term > state.term, -1,
-                                    state.leader_id)).astype(i32)
+        role = jnp.where(
+            win, int(Role.LEADER),
+            jnp.where(new_term > my_term1, int(Role.FOLLOWER),
+                      jnp.where(i_cand, int(Role.CANDIDATE), state.role)),
+        ).astype(i32)
+        became = win & (state.role != int(Role.LEADER))
+        i_lead = role == int(Role.LEADER)
+        leader_id = jnp.where(win, me,
+                              jnp.where(new_term > state.term, -1,
+                                        state.leader_id)).astype(i32)
 
-    # ------------------------------------------------------------------
-    # Phase C — leader append: NOOP on election (dare_server.c:1487),
-    # then the client batch (get_tailq_message → log_append_entry,
-    # dare_ibv_ud.c:780-790).
-    # ------------------------------------------------------------------
-    noop_data = jnp.zeros((1, cfg.slot_words), i32)
-    noop_meta = jnp.zeros((1, META_W), i32).at[0, M_TYPE].set(
-        int(EntryType.NOOP))
-    log1, end1 = append_batch(
-        state.log, state.end, state.head, noop_data, noop_meta,
-        jnp.where(became, 1, 0).astype(i32), new_term)
-    log2, end2 = append_batch(
-        log1, end1, state.head, inp.batch_data, inp.batch_meta,
-        jnp.where(i_lead, inp.batch_count, 0).astype(i32), new_term)
+        # --------------------------------------------------------------
+        # Phase C — leader append: NOOP on election (dare_server.c:1487),
+        # then the client batch (get_tailq_message → log_append_entry,
+        # dare_ibv_ud.c:780-790).
+        # --------------------------------------------------------------
+        noop_data = jnp.zeros((1, cfg.slot_words), i32)
+        noop_meta = jnp.zeros((1, META_W), i32).at[0, M_TYPE].set(
+            int(EntryType.NOOP))
+        log1, end1 = append_batch(
+            state.log, state.end, state.head, noop_data, noop_meta,
+            jnp.where(became, 1, 0).astype(i32), new_term)
+        log2, end2 = append_batch(
+            log1, end1, state.head, inp.batch_data, inp.batch_meta,
+            jnp.where(i_lead, inp.batch_count, 0).astype(i32), new_term)
 
     # ------------------------------------------------------------------
     # Phase D — leader fan-out. Window floored at the minimum reachable
@@ -460,9 +487,14 @@ def replica_step(
     epoch2 = jnp.where(have_cfg, cfg_words[3], state.ccfg_epoch)
     in_new2 = _popcount_vec(bm_new2, R)
     in_old2 = _popcount_vec(bm_old2, R)
-    maj_new2 = jnp.sum(in_new2) // 2 + 1
     maj_old2 = jnp.sum(in_old2) // 2 + 1
     transit2 = (cid2 == int(ConfigState.TRANSIT)).astype(i32)
+    # EXTENDED post-absorb: commit quorum on the old config (joiner
+    # replicates but does not count) — same rule as the pre-step masks
+    ext2 = cid2 == int(ConfigState.EXTENDED)
+    q_mask2 = jnp.where(ext2, bm_old2, bm_new2)
+    in_q2 = _popcount_vec(q_mask2, R)
+    maj_q2 = jnp.sum(in_q2) // 2 + 1
 
     # ------------------------------------------------------------------
     # Phase F — ACK + quorum commit. The ack is the *verified match
@@ -482,7 +514,7 @@ def replica_step(
         slot_of(state.commit + jnp.arange(W, dtype=i32), cfg.n_slots), M_TERM]
     scanned = commit_scan(
         acks_pad, state.commit, new_term2, end3, terms_win,
-        bm_old2, bm_new2, transit2, maj_old2, maj_new2,
+        bm_old2, q_mask2, transit2, maj_old2, maj_q2,
         use_pallas=use_pallas, interpret=interpret)
     commit2 = jnp.where(i_lead2, jnp.maximum(state.commit, scanned), commit1)
 
@@ -541,7 +573,7 @@ def replica_step(
         leadership_verified=(
             i_lead2
             & (jnp.sum((heard & (g_acks[:, 1] == me)).astype(i32)
-                       * in_new2) >= maj_new2)
+                       * in_q2) >= maj_q2)
             & ((transit2 <= 0)
                | (jnp.sum((heard & (g_acks[:, 1] == me)).astype(i32)
                           * in_old2) >= maj_old2))).astype(i32),

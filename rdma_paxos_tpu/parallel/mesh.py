@@ -59,7 +59,8 @@ def _unsqueeze(tree):
 
 def build_spmd_step(cfg: LogConfig, n_replicas: int, mesh: Mesh, *,
                     use_pallas: bool = False, interpret: bool = False,
-                    donate: bool = True, fanout: str = "gather"):
+                    donate: bool = True, fanout: str = "gather",
+                    elections: bool = True):
     """Compile the protocol step over a real device mesh.
 
     Takes/returns *batched* pytrees (leading ``replica`` axis, sharded one
@@ -71,7 +72,7 @@ def build_spmd_step(cfg: LogConfig, n_replicas: int, mesh: Mesh, *,
     core = functools.partial(
         replica_step, cfg=cfg, n_replicas=n_replicas,
         axis_name=REPLICA_AXIS, use_pallas=use_pallas, interpret=interpret,
-        fanout=fanout)
+        fanout=fanout, elections=elections)
 
     def per_device(state_b, inp_b):
         st, out = core(_squeeze(state_b), _squeeze(inp_b))
@@ -95,17 +96,19 @@ def build_sim_burst(cfg: LogConfig, n_replicas: int, *,
     ``rc_write_remote_logs`` ``dare_ibv_rc.c:1870-1948``).
 
     No elections fire inside a burst (timeouts forced 0; every scan step
-    carries the leader heartbeat) and the host apply echo is folded into
-    the carry so pruning frees ring space mid-burst. K is the leading axis
-    of the stacked inputs; returns the final state plus per-step stacked
-    outputs for exact host accounting."""
+    carries the leader heartbeat), so the burst compiles the STABLE step
+    (``elections=False`` — Phase B could only ever be a no-op; statically
+    removing it drops one collective per scan step). The host apply echo
+    is folded into the carry so pruning frees ring space mid-burst. K is
+    the leading axis of the stacked inputs; returns the final state plus
+    per-step stacked outputs for exact host accounting."""
     import jax.numpy as jnp
     from jax import lax
 
     core = functools.partial(
         replica_step, cfg=cfg, n_replicas=n_replicas,
         axis_name=REPLICA_AXIS, use_pallas=use_pallas, interpret=interpret,
-        fanout=fanout)
+        fanout=fanout, elections=False)
     vstep = jax.vmap(core, in_axes=(0, 0), axis_name=REPLICA_AXIS)
     zeros_r = jnp.zeros((n_replicas,), jnp.int32)
 
@@ -134,7 +137,7 @@ def build_spmd_burst(cfg: LogConfig, n_replicas: int, mesh: Mesh, *,
     core = functools.partial(
         replica_step, cfg=cfg, n_replicas=n_replicas,
         axis_name=REPLICA_AXIS, use_pallas=use_pallas, interpret=interpret,
-        fanout=fanout)
+        fanout=fanout, elections=False)
 
     def per_device(state_b, datas_b, metas_b, counts_b, peer_b):
         st = _squeeze(state_b)
@@ -163,12 +166,13 @@ def build_spmd_burst(cfg: LogConfig, n_replicas: int, mesh: Mesh, *,
 
 def build_sim_step(cfg: LogConfig, n_replicas: int, *,
                    use_pallas: bool = False, interpret: bool = False,
-                   donate: bool = True, fanout: str = "gather"):
+                   donate: bool = True, fanout: str = "gather",
+                   elections: bool = True):
     """Compile the protocol step as an N-replica simulation on one device
     (``vmap`` with a named axis — identical collective semantics)."""
     core = functools.partial(
         replica_step, cfg=cfg, n_replicas=n_replicas,
         axis_name=REPLICA_AXIS, use_pallas=use_pallas, interpret=interpret,
-        fanout=fanout)
+        fanout=fanout, elections=elections)
     mapped = jax.vmap(core, in_axes=(0, 0), axis_name=REPLICA_AXIS)
     return jax.jit(mapped, donate_argnums=(0,) if donate else ())

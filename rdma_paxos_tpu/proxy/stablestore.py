@@ -63,8 +63,17 @@ class HardState:
     the device step replicates the pair to live peers' ``vote_rec_*``
     state; this file is the host-side persistence layer the driver writes
     between steps, so a crash-recovered replica restores
-    ``max(peer records, this file)`` and can never double-vote in a term.
-    Atomic: temp file + fsync + rename."""
+    ``max(peer records, this file)``.
+    Atomic: temp file + fsync + rename + directory fsync.
+
+    Durability window: the pair is persisted AFTER the step in which the
+    vote was gathered and counted, so for that one step the vote exists
+    only in live peers' volatile ``vote_rec_*`` memory — the same
+    guarantee as the reference, whose ``rc_replicate_vote`` also writes
+    only into a majority's volatile remote memory (``dare_ibv_rc.c:1049``);
+    recovery therefore always consults the peer records AND this file
+    (``recover_vote``), and a whole-cluster power loss inside that window
+    is outside both designs' fault model."""
 
     def __init__(self, path: str):
         self.path = path
@@ -80,6 +89,14 @@ class HardState:
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self.path)
+        # fsync the parent directory so the rename itself survives power
+        # loss (otherwise the new file may be lost with the old unlinked)
+        dfd = os.open(os.path.dirname(os.path.abspath(self.path)) or ".",
+                      os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
         self._last = tup
 
     def load(self):

@@ -127,6 +127,7 @@ class ClusterDriver:
                 log_path=logp))
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self.loop_error: Optional[BaseException] = None
 
     # ------------------------------------------------------------------
     # shim event intake (called from proxy link threads)
@@ -137,6 +138,10 @@ class ClusterDriver:
             """Returns None (pass through), an int status (<0 severs the
             connection), or a PendingEvent (block until committed)."""
             with self._lock:
+                if self.loop_error is not None or self._stop.is_set():
+                    # no poll loop will ever release a commit wait: fail
+                    # fast so the app severs and the client retries
+                    return -1
                 rt = self.runtimes[r]
                 if etype == int(EntryType.CONNECT):
                     # our own replay connections (recognized by peer port)
@@ -434,7 +439,22 @@ class ClusterDriver:
         def loop():
             pacer = Pacer(period) if period else None
             while not self._stop.is_set():
-                self.step()
+                try:
+                    self.step()
+                except Exception as exc:  # noqa: BLE001
+                    # a raised step must never silently kill the poll
+                    # thread with app threads parked on commit waits:
+                    # record it, fail every blocked event so the apps
+                    # sever/retry, and stop the loop
+                    import traceback
+                    self.loop_error = exc
+                    traceback.print_exc()
+                    with self._lock:
+                        for rt in self.runtimes:
+                            while rt.inflight:
+                                ev, _ = rt.inflight.popleft()
+                                ev.release(-1)
+                    return
                 with self._lock:
                     busy = (any(self._submitq)
                             or any(len(q) for q in self.cluster.pending)
@@ -448,6 +468,13 @@ class ClusterDriver:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5)
+        # release commit waiters that were already inflight at stop —
+        # nothing will ever step again, so they must fail, not hang
+        with self._lock:
+            for rt in self.runtimes:
+                while rt.inflight:
+                    ev, _ = rt.inflight.popleft()
+                    ev.release(-1)
         for rt in self.runtimes:
             if rt.proxy:
                 rt.proxy.close()

@@ -67,6 +67,7 @@ class HostReplicaDriver:
         self._sharding = NamedSharding(self.mesh, P(REPLICA_AXIS))
         # real deployments run full-connectivity meshes: the O(W) psum
         # fan-out is sound there (see replica_step's fanout docstring)
+        self._fanout = fanout
         self._step = build_spmd_step(cfg, self.R, self.mesh, fanout=fanout)
 
         def fetch(state_b, starts):
@@ -141,6 +142,17 @@ class HostReplicaDriver:
             meta[i, M_CONN] = conn
             meta[i, M_REQID] = req
             meta[i, M_LEN] = len(payload)
+        if peer_mask is not None and self._fanout == "psum":
+            # the psum fan-out is sound only under full connectivity: a
+            # partition mask could leave two self-claimed leaders whose
+            # windows SUM instead of being selected — reject loudly
+            # rather than corrupt logs (use fanout="gather" to simulate
+            # partitions)
+            if not np.all(np.asarray(peer_mask) != 0):
+                raise ValueError(
+                    "psum fan-out requires an all-ones peer_mask; "
+                    "build the driver with fanout='gather' to model "
+                    "partitions")
         pm = (np.ones(self.R, np.int32) if peer_mask is None
               else peer_mask.astype(np.int32))
         return StepInput(

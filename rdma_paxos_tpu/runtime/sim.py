@@ -39,7 +39,7 @@ class SimCluster:
     def __init__(self, cfg: LogConfig, n_replicas: int,
                  group_size: Optional[int] = None, *, mode: str = "sim",
                  use_pallas: bool = False, interpret: bool = False,
-                 fanout: str = "gather"):
+                 fanout: str = "gather", stable_fast_path: bool = True):
         self.cfg = cfg
         self.R = n_replicas
         self.group_size = group_size or n_replicas
@@ -47,30 +47,23 @@ class SimCluster:
         self._use_pallas = use_pallas
         self._interpret = interpret
         self._fanout = fanout
+        # dispatch the elections-free STABLE step on iterations where no
+        # election timer fired (the latency hot path — Phase B statically
+        # removed, one fewer collective); compiled lazily on first use
+        self._stable_fast_path = stable_fast_path
         self.state = stack_states(cfg, n_replicas, self.group_size)
-        key = (cfg, n_replicas, mode, use_pallas, interpret, fanout)
-        cached = self._STEP_CACHE.get(key)
         if mode == "spmd":
-            if cached is None:
-                mesh = make_replica_mesh(n_replicas)
-                cached = (build_spmd_step(cfg, n_replicas, mesh,
-                                          use_pallas=use_pallas,
-                                          interpret=interpret,
-                                          fanout=fanout), mesh)
-                self._STEP_CACHE[key] = cached
-            self._step, self.mesh = cached
+            mkey = (cfg, n_replicas, "mesh")
+            if mkey not in self._STEP_CACHE:
+                self._STEP_CACHE[mkey] = make_replica_mesh(n_replicas)
+            self.mesh = self._STEP_CACHE[mkey]
+            self._step = self._build_step(elections=True)
             self.state = jax.device_put(
                 self.state,
                 jax.sharding.NamedSharding(
                     self.mesh, jax.sharding.PartitionSpec("replica")))
         else:
-            if cached is None:
-                cached = (build_sim_step(cfg, n_replicas,
-                                         use_pallas=use_pallas,
-                                         interpret=interpret,
-                                         fanout=fanout), None)
-                self._STEP_CACHE[key] = cached
-            self._step = cached[0]
+            self._step = self._build_step(elections=True)
         self._fetch = jax.jit(
             lambda log, start: fetch_window(log, start,
                                             window_slots=cfg.window_slots))
@@ -97,6 +90,14 @@ class SimCluster:
 
     def partition(self, groups: Sequence[Sequence[int]]) -> None:
         """Split the cluster: replicas hear only same-group peers."""
+        if self._fanout == "psum":
+            # the O(W) psum fan-out assumes at most one self-claimed
+            # leader (full connectivity); two partitioned leaders would
+            # SUM their windows into followers' logs — reject loudly
+            # (see replica_step's fanout docstring)
+            raise ValueError(
+                "partitions cannot be modeled with fanout='psum'; "
+                "build the cluster with fanout='gather'")
         self.peer_mask[:] = 0
         for g in groups:
             for i in g:
@@ -111,6 +112,10 @@ class SimCluster:
 
     def _build_inputs(self, timeouts: Sequence[int]) -> StepInput:
         cfg, R = self.cfg, self.R
+        if self._fanout == "psum" and not self.peer_mask.all():
+            raise ValueError(
+                "psum fan-out requires full connectivity; use "
+                "fanout='gather' to model partitions")
         B = cfg.batch_slots
         data = np.zeros((R, B, cfg.slot_words), np.int32)
         meta = np.zeros((R, B, META_W), np.int32)
@@ -210,19 +215,50 @@ class SimCluster:
                          "peer_acked", "leadership_verified")}
         acc = np.asarray(outs.accepted).sum(axis=0)         # [R]
         res["accepted"] = acc
+        # Shortfall: appends stop entirely the step the replica is not
+        # leader and the capacity clamp drops suffixes only, so the
+        # appended set is always a PREFIX of ``taken`` — requeue the
+        # remainder in order, exactly like step() does (never raise:
+        # this runs on the poll thread). A replica deposed mid-burst
+        # drops its remainder by design, mirroring step()'s non-leader
+        # rule — the driver fails the blocked events so clients retry
+        # against the new leader.
         for r in range(R):
             if taken[r] and res["role"][r] == int(Role.LEADER):
-                if int(acc[r]) < len(taken[r]):
-                    raise AssertionError(
-                        f"burst dropped entries on leader {r}: "
-                        f"{acc[r]} < {len(taken[r])} despite sizing")
+                a = int(acc[r])
+                if a < len(taken[r]):
+                    self.pending[r] = taken[r][a:] + self.pending[r]
         self._replay_committed(res)
         self.last = res
         return res
 
+    def _build_step(self, *, elections: bool):
+        """Compile (or fetch cached) the protocol step for this cluster's
+        static config — the single source for both the full and stable
+        variants, so they can never drift apart in build flags."""
+        key = (self.cfg, self.R, self._mode, self._use_pallas,
+               self._interpret, self._fanout, elections)
+        cached = self._STEP_CACHE.get(key)
+        if cached is None:
+            kw = dict(use_pallas=self._use_pallas,
+                      interpret=self._interpret, fanout=self._fanout,
+                      elections=elections)
+            if self._mode == "spmd":
+                cached = build_spmd_step(self.cfg, self.R, self.mesh, **kw)
+            else:
+                cached = build_sim_step(self.cfg, self.R, **kw)
+            self._STEP_CACHE[key] = cached
+        return cached
+
     def step(self, timeouts: Sequence[int] = ()) -> Dict[str, np.ndarray]:
+        timeouts = list(timeouts)       # may be a one-shot iterable
         inp = self._build_inputs(timeouts)
-        self.state, out = self._step(self.state, inp)
+        # no timer fired ⟹ Phase B is provably a no-op: dispatch the
+        # stable step (bit-identical outputs, one fewer collective)
+        fn = (self._build_step(elections=False)
+              if self._stable_fast_path and not timeouts
+              else self._step)
+        self.state, out = fn(self.state, inp)
         res = {k: np.asarray(getattr(out, k))
                for k in ("term", "role", "leader_id", "voted_term",
                          "voted_for", "head", "apply",

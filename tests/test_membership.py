@@ -115,3 +115,68 @@ def test_election_under_new_config_after_upsize():
     c.submit(3, b"new-member-leads")
     res = c.step()
     assert res["commit"][3] == res["end"][3]
+
+
+def test_extended_joiner_replicates_but_does_not_vote():
+    """EXTENDED phase: the joiner receives the replication window (it is
+    in bitmask_new) but quorum stays on the OLD config — the joiner's ack
+    is neither needed nor counted for commit, and the joiner cannot stand
+    for election (reference EXTENDED semantics,
+    dare_ibv_ud.c:1024-1037)."""
+    c = SimCluster(CFG, 8, group_size=3)
+    mm = MembershipManager(c)
+    c.run_until_elected(0)
+    c.step()
+    mm.submit_extended(0, 0b111, 3, epoch=1)
+    res = c.step()
+    cur = mm.current(0)
+    assert cur["cid_state"] == int(ConfigState.EXTENDED)
+    assert cur["bitmask_new"] == 0b1111
+
+    # the joiner absorbs windows: its end catches up to the leader's
+    for _ in range(3):
+        res = c.step()
+    assert int(res["end"][3]) == int(res["end"][0])
+
+    # quorum unchanged: commit advances with the joiner partitioned away
+    c.partition([[0, 1, 2], [3]])
+    c.submit(0, b"no-joiner-needed")
+    res = c.step()
+    assert int(res["commit"][0]) == int(res["end"][0])
+
+    # but still needs 2 of the OLD three: joiner's ack cannot substitute
+    c.partition([[0, 3], [1], [2]])
+    c.submit(0, b"joiner-cannot-vote")
+    res = c.step()
+    res = c.step()
+    assert int(res["commit"][0]) < int(res["end"][0])
+
+    # joiner firing its election timer while EXTENDED goes nowhere
+    c.heal()
+    c.step(timeouts=[3])
+    assert int(c.last["role"][3]) != int(Role.LEADER)
+
+
+def test_full_join_ladder_extended_transit_stable():
+    """EXTENDED → TRANSIT → STABLE admits the joiner as a full voting
+    member at the end (the reference's complete join path,
+    dare_server.c:1861-1937)."""
+    c = SimCluster(CFG, 8, group_size=3)
+    mm = MembershipManager(c)
+    c.run_until_elected(0)
+    c.submit(0, b"history")
+    c.step()
+    mm.join(0, 3)
+    cur = mm.current(0)
+    assert cur["cid_state"] == int(ConfigState.STABLE)
+    assert cur["bitmask_new"] == 0b1111
+    # the joiner now counts: 3-of-4 majority holds with one old member out
+    c.partition([[0, 1, 3], [2]])
+    c.submit(0, b"joiner-votes-now")
+    res = c.step()
+    assert int(res["commit"][0]) == int(res["end"][0])
+    # joiner replayed the full history
+    c.heal()
+    c.step()
+    stream3 = [p for (_, _, _, p) in c.replayed[3]]
+    assert b"history" in stream3
