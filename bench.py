@@ -120,6 +120,11 @@ def main():
             "ops_5_replicas": round(per_group[5][0], 1),
             "ops_7_replicas": round(per_group[7][0], 1),
             "backend": jax.default_backend(),
+            # all R replicas' device work runs on ONE chip here (vmapped
+            # axis), so ops/s ~ 1/R is the simulation topology, not the
+            # protocol: per-replica work is R-invariant outside O(R)
+            # scalar gathers — see ANALYSIS_R_SCALING.md
+            "topology": "single-chip vmap simulation (R rings, 1 chip)",
         },
     }))
 

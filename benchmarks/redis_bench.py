@@ -1,0 +1,149 @@
+#!/usr/bin/env python
+"""The reference's EXACT headline benchmark: ``redis-benchmark -t set``
+against the leader of a replicated group of pristine Redis servers under
+``LD_PRELOAD=interpose.so`` (``benchmarks/run.sh:73-82``).
+
+Builds Redis 2.8.17 from the reference tree's vendored upstream tarball
+(the version ``apps/redis/mk`` targets), boots N replicas + the consensus
+driver, elects, runs redis-benchmark with the reference's flags, and
+checks follower state equality (DBSIZE).
+
+    python benchmarks/redis_bench.py --replicas 3 -n 10000 -c 8 -P 64
+"""
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+NATIVE = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+TARBALL = "/root/reference/apps/redis/redis-2.8.17.tar.gz"
+BUILD_ROOT = "/tmp/rp_redis_build"
+SRC = os.path.join(BUILD_ROOT, "redis-2.8.17", "src")
+
+
+def ensure_redis():
+    if os.path.exists(os.path.join(SRC, "redis-server")):
+        return
+    if not os.path.exists(TARBALL):
+        raise SystemExit("reference redis tarball unavailable")
+    os.makedirs(BUILD_ROOT, exist_ok=True)
+    subprocess.run(["tar", "xzf", TARBALL], cwd=BUILD_ROOT, check=True)
+    subprocess.run(["make", "MALLOC=libc", "-j1"],
+                   cwd=os.path.join(BUILD_ROOT, "redis-2.8.17"),
+                   check=True)
+
+
+def resp(port, line):
+    s = socket.create_connection(("127.0.0.1", port), timeout=10)
+    f = s.makefile("rb")
+    s.sendall(line + b"\r\n")
+    out = f.readline().strip()
+    s.close()
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("-n", type=int, default=10000)
+    ap.add_argument("-c", type=int, default=8)
+    ap.add_argument("-P", type=int, default=64,
+                    help="redis-benchmark pipeline depth")
+    ap.add_argument("-r", type=int, default=0,
+                    help="randomize keys over this keyspace (stronger "
+                         "follower-equality evidence than the default "
+                         "single-key workload)")
+    ap.add_argument("--port-base", type=int, default=9860)
+    args = ap.parse_args()
+
+    ensure_redis()
+    os.environ.setdefault(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    import jax
+    if os.environ.get("RP_BENCH_CPU", "1") == "1":
+        jax.config.update("jax_platforms", "cpu")
+    from rdma_paxos_tpu.config import LogConfig, TimeoutConfig
+    from rdma_paxos_tpu.runtime.driver import ClusterDriver
+
+    cfg = LogConfig(n_slots=2048, slot_bytes=512, window_slots=64,
+                    batch_slots=64)
+    ports = [args.port_base + i for i in range(args.replicas)]
+    wd = tempfile.mkdtemp(prefix="rp_redisbench_")
+    subprocess.run(["make", "-C", NATIVE], check=True,
+                   capture_output=True)
+
+    driver = ClusterDriver(
+        cfg, args.replicas, workdir=wd, app_ports=ports,
+        timeout_cfg=TimeoutConfig(elec_timeout_low=0.5,
+                                  elec_timeout_high=1.0))
+    apps = []
+    for r, port in enumerate(ports):
+        env = dict(os.environ)
+        env["LD_PRELOAD"] = os.path.join(NATIVE, "interpose.so")
+        env["RP_PROXY_SOCK"] = os.path.join(wd, f"proxy{r}.sock")
+        apps.append(subprocess.Popen(
+            [os.path.join(SRC, "redis-server"), "--port", str(port),
+             "--bind", "127.0.0.1", "--save", "", "--appendonly", "no"],
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL))
+    for port in ports:
+        while True:
+            try:
+                socket.create_connection(("127.0.0.1", port),
+                                         timeout=2).close()
+                break
+            except OSError:
+                time.sleep(0.1)
+    driver.run(period=0.0005)
+    t0 = time.time()
+    while driver.leader() < 0:
+        time.sleep(0.05)
+        if time.time() - t0 > 120:
+            raise SystemExit("no leader elected")
+    lead = driver.leader()
+    print(f"leader: replica {lead} (redis on port {ports[lead]})")
+
+    # the reference's client (run.sh:73-82), with pipelining
+    cmd = [os.path.join(SRC, "redis-benchmark"), "-p", str(ports[lead]),
+           "-t", "set", "-n", str(args.n), "-c", str(args.c),
+           "-P", str(args.P)]
+    if args.r:
+        cmd += ["-r", str(args.r)]
+    bench = subprocess.run(cmd, capture_output=True, timeout=600)
+    out = bench.stdout.decode()
+    print("\n".join(l for l in out.splitlines()
+                    if "requests per second" in l or "SET" in l))
+
+    # follower state equality, the run.sh FindLeader+verify analog
+    time.sleep(2.0)
+    lead_size = resp(ports[lead], b"DBSIZE")
+    for r in range(args.replicas):
+        if r == lead:
+            continue
+        deadline = time.time() + 30
+        size = None
+        while time.time() < deadline:
+            size = resp(ports[r], b"DBSIZE")
+            if size == lead_size:
+                break
+            time.sleep(0.5)
+        print(f"replica {r} DBSIZE {size.decode()} "
+              f"(leader {lead_size.decode()})"
+              + ("  OK" if size == lead_size else "  MISMATCH"))
+
+    driver.stop()
+    for a in apps:
+        a.kill()
+        a.wait()
+
+
+if __name__ == "__main__":
+    main()

@@ -56,6 +56,13 @@ def main():
     ap.add_argument("--period", type=float, default=0.0005)
     ap.add_argument("--pipeline", type=int, default=1,
                     help="commands per client batch (redis-benchmark -P)")
+    ap.add_argument("--threaded-app", action="store_true",
+                    help="run toyserver thread-per-connection (memcached"
+                         "-style): each client's reads block in the shim "
+                         "commit wait concurrently, exercising the "
+                         "pipelined shim")
+    ap.add_argument("--json", default=None,
+                    help="append a JSON result line to this file")
     args = ap.parse_args()
 
     os.environ.setdefault(
@@ -82,9 +89,11 @@ def main():
         env = dict(os.environ)
         env["LD_PRELOAD"] = os.path.join(NATIVE, "interpose.so")
         env["RP_PROXY_SOCK"] = os.path.join(wd, f"proxy{r}.sock")
-        apps.append(subprocess.Popen(
-            [os.path.join(NATIVE, "toyserver"), str(port)], env=env,
-            stderr=subprocess.DEVNULL))
+        cmd = [os.path.join(NATIVE, "toyserver"), str(port)]
+        if args.threaded_app:
+            cmd.append("-t")
+        apps.append(subprocess.Popen(cmd, env=env,
+                                     stderr=subprocess.DEVNULL))
     time.sleep(0.3)
     driver.run(period=args.period)
     t0 = time.time()
@@ -114,10 +123,24 @@ def main():
     nb = len(lat)
     n = per * args.clients
     print(f"committed SETs: {n} in {dt:.2f}s -> {n / dt:.0f} ops/s "
-          f"({args.clients} clients, pipeline {args.pipeline})")
+          f"({args.clients} clients, pipeline {args.pipeline}"
+          f"{', threaded app' if args.threaded_app else ''})")
     print(f"per-batch latency p50={lat[nb // 2] * 1e3:.2f}ms "
           f"p95={lat[int(nb * .95)] * 1e3:.2f}ms "
           f"p99={lat[int(nb * .99)] * 1e3:.2f}ms")
+    if args.json:
+        import json
+        with open(args.json, "a") as jf:
+            jf.write(json.dumps(dict(
+                metric="e2e_committed_ops_per_sec",
+                value=round(n / dt, 1),
+                requests=n, seconds=round(dt, 3),
+                clients=args.clients, pipeline=args.pipeline,
+                threaded_app=bool(args.threaded_app),
+                p50_ms=round(lat[nb // 2] * 1e3, 2),
+                p95_ms=round(lat[int(nb * .95)] * 1e3, 2),
+                p99_ms=round(lat[int(nb * .99)] * 1e3, 2),
+            )) + "\n")
 
     # replication check on one follower
     fol = next(r for r in range(args.replicas) if r != lead)

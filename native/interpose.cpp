@@ -1,4 +1,4 @@
-// interpose — LD_PRELOAD syscall-interposition shim.
+// interpose — LD_PRELOAD syscall-interposition shim (pipelined).
 //
 // Native-equivalent of the reference's spec_hooks.cpp: hooks
 // __libc_start_main (init before the app's main, :48-100), accept/accept4
@@ -6,23 +6,31 @@
 // fstat S_IFSOCK (:113-116). Where the reference calls straight into the
 // in-process proxy (proxy_on_accept/read/close, rsm-interface.h:12-15),
 // this shim forwards each event over a Unix domain socket to the replica
-// driver daemon and blocks until the driver acknowledges — on the leader
-// the ack arrives only after the event is committed by the consensus core,
-// reproducing the reference's spin-until-committed-and-applied semantics
-// (proxy.c:160) without sharing an address space with JAX.
+// driver daemon and blocks the CALLING THREAD until the driver
+// acknowledges — on the leader the ack arrives only after the event is
+// committed by the consensus core, reproducing the reference's
+// spin-until-committed-and-applied semantics (proxy.c:160).
+//
+// Pipelined: the reference splits its hot path into a spinlock-protected
+// tailq INSERT followed by a per-thread spin on the commit counter
+// (proxy.c:114-160), so every app thread can have an event in flight
+// concurrently. This shim does the same: the socket write (the enqueue)
+// holds a short mutex, a dedicated reader thread distributes seq-tagged
+// responses, and each app thread waits only for ITS OWN event — a
+// multithreaded app commits many events per commit-latency, instead of
+// one per process.
 //
 // Env:
 //   RP_PROXY_SOCK  — path of the driver's Unix socket. Unset => all hooks
 //                    pass through untouched (the app runs unreplicated).
 //
 // Wire format (little-endian):
-//   request : [u8 op][i32 fd][u32 len][len bytes]   op: 1=HELLO 2=CONNECT
-//                                                       3=SEND  4=CLOSE
-//   response: [i32 status]   >=0 ok / pass; <0 drop connection
+//   request : [u8 op][u32 seq][i32 fd][u32 len][len bytes]
+//                                  op: 1=HELLO 2=CONNECT 3=SEND 4=CLOSE
+//   response: [u32 seq][i32 status]   >=0 ok / pass; <0 drop connection
 //
 // Build: make -C native  ->  interpose.so
 
-#include <cstdarg>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
@@ -55,9 +63,23 @@ close_fn real_close;
 main_fn real_main;
 
 int proxy_fd = -1;                    // UDS to the driver daemon
-pthread_mutex_t proxy_mu = PTHREAD_MUTEX_INITIALIZER;
+pthread_mutex_t send_mu = PTHREAD_MUTEX_INITIALIZER;  // write serialization
 constexpr int kMaxFd = 65536;
 unsigned char tracked[kMaxFd];        // fds that arrived through accept()
+
+// ---- pipelined response plumbing -----------------------------------------
+
+constexpr int kPendingCap = 256;      // max in-flight events per process
+struct Pending {
+  uint32_t seq;                       // 0 = slot free
+  int32_t status;
+  bool done;
+};
+Pending pending[kPendingCap];
+pthread_mutex_t resp_mu = PTHREAD_MUTEX_INITIALIZER;
+pthread_cond_t resp_cv = PTHREAD_COND_INITIALIZER;
+uint32_t next_seq = 1;
+bool driver_dead = false;
 
 void resolve() {
   real_accept = (accept_fn)dlsym(RTLD_NEXT, "accept");
@@ -80,27 +102,87 @@ bool io_exact(int fd, void* buf, size_t n, bool writing) {
   return true;
 }
 
-// Send one event and wait for the driver's verdict. Thread-safe: the app
-// may serve connections from many threads (the reference serializes the
-// same way with the tailq spinlock, message.h:22).
+// Reader thread: distributes seq-tagged responses to waiting app threads.
+// EOF / error => the driver died: stop interposing, release every waiter
+// with pass-through status 0 (the app keeps serving unreplicated — same
+// fallback as before, now process-wide in one place).
+void* reader_main(void*) {
+  for (;;) {
+    uint8_t buf[8];
+    if (!io_exact(proxy_fd, buf, sizeof buf, false)) break;
+    uint32_t seq;
+    int32_t status;
+    memcpy(&seq, buf, 4);
+    memcpy(&status, buf + 4, 4);
+    pthread_mutex_lock(&resp_mu);
+    for (int i = 0; i < kPendingCap; i++) {
+      if (pending[i].seq == seq) {
+        pending[i].status = status;
+        pending[i].done = true;
+        break;
+      }
+    }
+    pthread_cond_broadcast(&resp_cv);
+    pthread_mutex_unlock(&resp_mu);
+  }
+  pthread_mutex_lock(&resp_mu);
+  driver_dead = true;
+  proxy_fd = -1;                      // hooks pass through from now on
+  pthread_cond_broadcast(&resp_cv);
+  pthread_mutex_unlock(&resp_mu);
+  return nullptr;
+}
+
+// Send one event and wait for the driver's verdict. The calling thread
+// blocks; other threads' events proceed concurrently.
 int32_t proxy_call(uint8_t op, int32_t fd, const void* data, uint32_t len) {
   if (proxy_fd < 0) return 0;
-  pthread_mutex_lock(&proxy_mu);
-  uint8_t hdr[9];
-  hdr[0] = op;
-  memcpy(hdr + 1, &fd, 4);
-  memcpy(hdr + 5, &len, 4);
-  int32_t status = 0;
-  bool ok = io_exact(proxy_fd, hdr, sizeof hdr, true) &&
-            (len == 0 || io_exact(proxy_fd, const_cast<void*>(data), len,
-                                  true)) &&
-            io_exact(proxy_fd, &status, 4, false);
-  if (!ok) {  // driver died: stop interposing, let the app run bare
-    real_close(proxy_fd);
-    proxy_fd = -1;
-    status = 0;
+
+  // claim a pending slot + a seq (the tailq-insert half)
+  pthread_mutex_lock(&resp_mu);
+  int slot = -1;
+  for (;;) {
+    if (driver_dead) {
+      pthread_mutex_unlock(&resp_mu);
+      return 0;
+    }
+    for (int i = 0; i < kPendingCap; i++) {
+      if (pending[i].seq == 0) {
+        slot = i;
+        break;
+      }
+    }
+    if (slot >= 0) break;
+    pthread_cond_wait(&resp_cv, &resp_mu);   // all slots in flight
   }
-  pthread_mutex_unlock(&proxy_mu);
+  uint32_t seq = next_seq++;
+  if (next_seq == 0) next_seq = 1;
+  pending[slot].seq = seq;
+  pending[slot].status = 0;
+  pending[slot].done = false;
+  pthread_mutex_unlock(&resp_mu);
+
+  uint8_t hdr[13];
+  hdr[0] = op;
+  memcpy(hdr + 1, &seq, 4);
+  memcpy(hdr + 5, &fd, 4);
+  memcpy(hdr + 9, &len, 4);
+  pthread_mutex_lock(&send_mu);       // short: enqueue order only
+  int pfd = proxy_fd;
+  bool ok = pfd >= 0 && io_exact(pfd, hdr, sizeof hdr, true) &&
+            (len == 0 ||
+             io_exact(pfd, const_cast<void*>(data), len, true));
+  pthread_mutex_unlock(&send_mu);
+
+  pthread_mutex_lock(&resp_mu);
+  if (!ok) driver_dead = true;
+  while (!pending[slot].done && !driver_dead)
+    pthread_cond_wait(&resp_cv, &resp_mu);
+  int32_t status = driver_dead ? 0 : pending[slot].status;
+  pending[slot].seq = 0;              // free the slot
+  pthread_cond_broadcast(&resp_cv);   // wake slot-waiters
+  if (driver_dead) proxy_fd = -1;
+  pthread_mutex_unlock(&resp_mu);
   return status;
 }
 
@@ -120,6 +202,13 @@ void rp_init() {
     return;
   }
   proxy_fd = fd;
+  pthread_t thr;
+  if (pthread_create(&thr, nullptr, reader_main, nullptr) != 0) {
+    real_close(fd);
+    proxy_fd = -1;
+    return;
+  }
+  pthread_detach(thr);
   int32_t pid = static_cast<int32_t>(getpid());
   proxy_call(OP_HELLO, pid, nullptr, 0);
 }

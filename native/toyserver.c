@@ -8,53 +8,91 @@
  *   DEL <key>\n          -> +OK\n
  *   COUNT\n              -> <n>\n
  * Uses accept()/read()/write()/close() directly — the exact syscall
- * surface the shim hooks. Single-threaded, poll-based, multiple clients.
+ * surface the shim hooks. Two serving modes:
+ *   toyserver <port>      poll-based single thread (redis-style)
+ *   toyserver <port> -t   thread-per-connection (memcached-style) — many
+ *                         reads block in the shim's commit wait
+ *                         concurrently, exercising its pipelining
  */
 #include <arpa/inet.h>
 #include <errno.h>
 #include <netinet/in.h>
 #include <poll.h>
+#include <pthread.h>
 #include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
 #include <sys/socket.h>
 #include <unistd.h>
 
-#define MAXKV 4096
+#define MAXKV 131072            /* open-addressing table, power of two */
 #define MAXC 64
 #define BUFSZ 65536
 
+/* Open-addressing hash KVS (linear probing, tombstone-free deletes by
+ * backward-shift) so benchmark-scale key counts stay O(1) per op. */
 static char keys[MAXKV][64], vals[MAXKV][256];
+static unsigned char used[MAXKV];
 static int nkv = 0;
 
+static unsigned kv_hash(const char* k) {
+  unsigned h = 2166136261u;
+  while (*k) h = (h ^ (unsigned char)*k++) * 16777619u;
+  return h & (MAXKV - 1);
+}
+static int kv_find(const char* k) {      /* slot of key, or -1 */
+  for (unsigned i = kv_hash(k), n = 0; n < MAXKV;
+       i = (i + 1) & (MAXKV - 1), n++) {
+    if (!used[i]) return -1;
+    if (!strcmp(keys[i], k)) return (int)i;
+  }
+  return -1;
+}
 static const char* kv_get(const char* k) {
-  for (int i = 0; i < nkv; i++)
-    if (!strcmp(keys[i], k)) return vals[i];
-  return NULL;
+  int i = kv_find(k);
+  return i < 0 ? NULL : vals[i];
 }
 static void kv_set(const char* k, const char* v) {
-  for (int i = 0; i < nkv; i++)
-    if (!strcmp(keys[i], k)) { snprintf(vals[i], 256, "%s", v); return; }
-  if (nkv < MAXKV) {
-    snprintf(keys[nkv], 64, "%s", k);
-    snprintf(vals[nkv], 256, "%s", v);
-    nkv++;
+  for (unsigned i = kv_hash(k), n = 0; n < MAXKV;
+       i = (i + 1) & (MAXKV - 1), n++) {
+    if (used[i] && !strcmp(keys[i], k)) {
+      snprintf(vals[i], 256, "%s", v);
+      return;
+    }
+    if (!used[i]) {
+      if (nkv >= MAXKV - 1) return;      /* table full: drop */
+      used[i] = 1;
+      snprintf(keys[i], 64, "%s", k);
+      snprintf(vals[i], 256, "%s", v);
+      nkv++;
+      return;
+    }
   }
 }
 static void kv_del(const char* k) {
-  for (int i = 0; i < nkv; i++)
-    if (!strcmp(keys[i], k)) {
-      memmove(&keys[i], &keys[nkv - 1], 64);
-      memmove(&vals[i], &vals[nkv - 1], 256);
-      nkv--;
-      return;
-    }
+  int i = kv_find(k);
+  if (i < 0) return;
+  used[i] = 0;
+  nkv--;
+  /* re-insert the probe chain after the hole */
+  for (unsigned j = (i + 1) & (MAXKV - 1); used[j];
+       j = (j + 1) & (MAXKV - 1)) {
+    used[j] = 0;
+    nkv--;
+    char kk[64], vv[256];
+    memcpy(kk, keys[j], 64);
+    memcpy(vv, vals[j], 256);
+    kv_set(kk, vv);
+  }
 }
 
 struct conn { int fd; char buf[BUFSZ]; int len; };
 
+static pthread_mutex_t kv_mu = PTHREAD_MUTEX_INITIALIZER;
+
 static void handle_line(int fd, char* line) {
   char out[512], k[64], v[256];
+  pthread_mutex_lock(&kv_mu);
   if (sscanf(line, "SET %63s %255[^\n]", k, v) == 2) {
     kv_set(k, v);
     snprintf(out, sizeof out, "+OK\n");
@@ -69,12 +107,39 @@ static void handle_line(int fd, char* line) {
   } else {
     snprintf(out, sizeof out, "-ERR\n");
   }
+  pthread_mutex_unlock(&kv_mu);
   ssize_t w = write(fd, out, strlen(out));
   (void)w;
 }
 
+/* ---- thread-per-connection mode ---- */
+static void* conn_main(void* arg) {
+  struct conn* c = (struct conn*)arg;
+  c->len = 0;
+  for (;;) {
+    ssize_t n = read(c->fd, c->buf + c->len, (size_t)(BUFSZ - c->len - 1));
+    if (n <= 0) break;
+    c->len += (int)n;
+    c->buf[c->len] = 0;
+    char* start = c->buf;
+    char* nl;
+    while ((nl = strchr(start, '\n'))) {
+      *nl = 0;
+      handle_line(c->fd, start);
+      start = nl + 1;
+    }
+    int rest = (int)(c->buf + c->len - start);
+    memmove(c->buf, start, (size_t)rest);
+    c->len = rest;
+  }
+  close(c->fd);
+  free(c);
+  return NULL;
+}
+
 int main(int argc, char** argv) {
   int port = argc > 1 ? atoi(argv[1]) : 7000;
+  int threaded = argc > 2 && !strcmp(argv[2], "-t");
   int ls = socket(AF_INET, SOCK_STREAM, 0);
   int one = 1;
   setsockopt(ls, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
@@ -84,7 +149,25 @@ int main(int argc, char** argv) {
   a.sin_port = htons((unsigned short)port);
   if (bind(ls, (struct sockaddr*)&a, sizeof a) != 0) { perror("bind"); return 1; }
   listen(ls, 64);
-  fprintf(stderr, "toyserver listening on %d\n", port);
+  fprintf(stderr, "toyserver listening on %d%s\n", port,
+          threaded ? " (threaded)" : "");
+
+  if (threaded) {
+    for (;;) {
+      int fd = accept(ls, NULL, NULL);
+      if (fd < 0) continue;
+      struct conn* c = (struct conn*)malloc(sizeof *c);
+      if (!c) { close(fd); continue; }
+      c->fd = fd;
+      pthread_t thr;
+      if (pthread_create(&thr, NULL, conn_main, c) != 0) {
+        close(fd);
+        free(c);
+        continue;
+      }
+      pthread_detach(thr);
+    }
+  }
 
   struct conn cs[MAXC];
   for (int i = 0; i < MAXC; i++) cs[i].fd = -1;

@@ -81,6 +81,13 @@ class EntryType(enum.IntEnum):
 # mechanism a joiner uses. The reference has the analogous bound in its
 # uint64 byte offsets (dare_log.h:77-103), just further away.
 M_TYPE, M_TERM, M_CONN, M_REQID, M_LEN, M_GIDX = 0, 1, 2, 3, 4, 5
+# M_GEN: the elastic generation of the submitting host incarnation —
+# lets a rebuilt host distinguish entries ITS CURRENT app served live
+# (gen matches: ack, don't replay) from entries a previous incarnation
+# originated (gen differs: replay into the rebuilt app like any remote
+# entry). An explicit column, not high bits of req_id, so neither
+# counter can overflow into misclassification.
+M_GEN = 6
 META_W = 8  # padded for alignment
 
 

@@ -109,6 +109,70 @@ def _install(state_b: ReplicaState, r, index, term, cur_term, voted_term,
     return dataclasses.replace(state_b, log=log, **out)
 
 
+def export_row(state_b: ReplicaState, r: int) -> dict:
+    """Pull replica ``r``'s full state row to host numpy — the transfer
+    unit of cross-generation recovery (the analog of the joiner
+    RDMA-reading the donor's snapshot buffer AND log tail in one shot,
+    ``rc_recover_sm`` + ``rc_recover_log``, ``dare_ibv_rc.c:603-856``).
+    Keys are ReplicaState field names; the log travels as ``log_buf``."""
+    out = {"log_buf": np.asarray(state_b.log.buf[r])}
+    for f in dataclasses.fields(ReplicaState):
+        if f.name == "log":
+            continue
+        out[f.name] = np.asarray(getattr(state_b, f.name)[r])
+    return out
+
+
+def genesis_row(donor_row: dict, *, group_mask: int, epoch: int,
+                n_replicas: int, term: Optional[int] = None) -> dict:
+    """Sanitize a donor row into the shared GENESIS state of a new
+    generation (elastic world rebuild — every member of the new world
+    installs an identical copy, so the cluster boots pre-synchronized).
+
+    Rules:
+
+    * The log (and head/apply/commit/end) carries over verbatim — the
+      donor is the most up-to-date survivor by Raft's election ordering
+      ``(last_log_term, end)``, so its log contains every entry committed
+      in the previous generation (Leader Completeness); its uncommitted
+      suffix is carried as an ordinary suffix the next leader's NOOP
+      commits or truncates.
+    * Retained CONFIG entries are re-typed NOOP: slot numbering changes
+      across generations, so an old-world bitmask must never resurface
+      through the latest-config-in-the-log derivation. The new world's
+      config is installed as both the live bitmasks and the committed
+      checkpoint (``ccfg_*``).
+    * ``term`` is bumped past every surviving member's term (caller
+      passes the gathered max) so no vote or leadership claim from the
+      dead world can conflict; votes and vote records reset — elections
+      in the new world are fresh.
+    * Roles reset to FOLLOWER; the new world elects normally.
+    """
+    from rdma_paxos_tpu.consensus.log import EntryType, M_TYPE
+    from rdma_paxos_tpu.consensus.state import ConfigState, Role
+
+    row = {k: np.array(v, copy=True) for k, v in donor_row.items()}
+    buf = row["log_buf"]
+    slot_words = buf.shape[-1] - META_W
+    types = buf[:, slot_words + M_TYPE]
+    types[types == int(EntryType.CONFIG)] = int(EntryType.NOOP)
+    new_term = (int(row["term"]) if term is None else int(term)) + 1
+    i32, u32 = np.int32, np.uint32
+    mask = u32(group_mask)
+    row.update(
+        term=i32(new_term), role=i32(int(Role.FOLLOWER)),
+        leader_id=i32(-1),
+        voted_term=i32(0), voted_for=i32(-1),
+        vote_rec_term=np.zeros(n_replicas, i32),
+        vote_rec_for=np.full(n_replicas, -1, i32),
+        cid_state=i32(int(ConfigState.STABLE)),
+        bitmask_old=mask, bitmask_new=mask, epoch=i32(epoch),
+        ccfg_old=mask, ccfg_new=mask,
+        ccfg_cid=i32(int(ConfigState.STABLE)), ccfg_epoch=i32(epoch),
+    )
+    return row
+
+
 def recover_vote(state_b: ReplicaState, r: int,
                  peers=None) -> tuple:
     """Read replica ``r``'s replicated vote back from peers' vote records

@@ -526,15 +526,26 @@ def replica_step(
     # Pruning is lazy and pressure-gated, like the reference: the periodic
     # pruner only trims what every reachable member has applied
     # (log_pruning P1/P2/P3 invariants, dare_server.c:1996-2067), and only
-    # once the ring is 3/4 full (force_log_pruning, :2069-2122) — so a
-    # transiently-partitioned laggard can still catch up from the log;
-    # one pruned past must snapshot-recover (host path), which is exactly
-    # the reference's straggler-eviction semantics.
+    # once the ring is 3/4 full — so a transiently-partitioned laggard can
+    # still catch up from the log; one pruned past must snapshot-recover
+    # (host path), which is exactly the reference's straggler-eviction
+    # semantics.
     pressure = (end3 - head1) > (3 * cfg.n_slots) // 4
     head2 = jnp.where(
         i_lead2 & pressure,
         jnp.clip(jnp.maximum(head1, min_apply), head1, apply2),
         head1)
+    # FORCED pruning (force_log_pruning analog, dare_server.c:2069-2122):
+    # a reachable member whose apply is frozen (wedged app) must not
+    # block the ring forever. Under HARD pressure (7/8 full) the leader
+    # advances the head past the laggard, bounded by its OWN applied
+    # offset — every recycled entry is applied + persisted on the leader,
+    # so the left-behind member can snapshot-recover from its store. The
+    # laggard's host detects head > its apply cursor and stops replaying
+    # (recycled slots must never reach the app) — see
+    # SimCluster._replay_committed / need_recovery.
+    hard = (end3 - head1) > (7 * cfg.n_slots) // 8
+    head2 = jnp.where(i_lead2 & hard, jnp.maximum(head2, apply2), head2)
 
     # committed-config checkpoint: the newest CONFIG entry now below
     # commit can never be truncated (backoff floors at commit), so it

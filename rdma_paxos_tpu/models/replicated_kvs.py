@@ -38,6 +38,15 @@ class ReplicatedKVS:
                                       for _ in range(cluster.R)]
         self._cursor = [0] * cluster.R
         self._apply_jit = jax.jit(apply_cmd)
+        # per-replica endpoint registry: client_id -> highest applied
+        # req_id (the dare_ep_db ``last_req_id`` analog,
+        # dare_ep_db.h:20-30). Folded DETERMINISTICALLY from the
+        # committed stream, so every replica — including any future
+        # leader — skips retransmitted requests identically; dedup
+        # therefore survives reconnects and failover
+        # (dare_ibv_ud.c:1004-1014 dedups the same way at the leader).
+        self.last_req: List[dict] = [dict() for _ in range(cluster.R)]
+        self.deduped: List[int] = [0] * cluster.R
 
     # ------------------------------------------------------------------
 
@@ -45,22 +54,36 @@ class ReplicatedKVS:
         """Fold newly committed commands into replica r's table."""
         stream = self.c.replayed[r]
         while self._cursor[r] < len(stream):
-            etype, _conn, _req, payload = stream[self._cursor[r]]
+            etype, conn, req, payload = stream[self._cursor[r]]
             self._cursor[r] += 1
             if etype != int(EntryType.SEND):
                 continue
             if len(payload) != CMD_W * 4:
                 continue                      # not a KVS command: skip
+            if req > 0 and conn > 0:
+                # session-stamped command: apply exactly once
+                if req <= self.last_req[r].get(conn, 0):
+                    self.deduped[r] += 1
+                    continue
+                self.last_req[r][conn] = req
             cmd = jnp.asarray(np.frombuffer(payload, "<i4"))
             self.tables[r], _ = self._apply_jit(self.tables[r], cmd)
 
     # ------------------------------------------------------------------
 
-    def put(self, leader: int, key: bytes, val: bytes) -> None:
-        self.c.submit(leader, encode_cmd(OP_PUT, key, val).tobytes())
+    def put(self, leader: int, key: bytes, val: bytes, *,
+            client_id: int = 0, req_id: int = 0) -> None:
+        self.c.submit(leader, encode_cmd(OP_PUT, key, val).tobytes(),
+                      conn=client_id, req_id=req_id)
 
-    def remove(self, leader: int, key: bytes) -> None:
-        self.c.submit(leader, encode_cmd(OP_RM, key).tobytes())
+    def remove(self, leader: int, key: bytes, *,
+               client_id: int = 0, req_id: int = 0) -> None:
+        self.c.submit(leader, encode_cmd(OP_RM, key).tobytes(),
+                      conn=client_id, req_id=req_id)
+
+    def session(self, client_id: int) -> "ClientSession":
+        """Open a retransmitting-client session (the UD-client analog)."""
+        return ClientSession(self, client_id)
 
     def get(self, r: int, key: bytes, *,
             linearizable: bool = False) -> Optional[bytes]:
@@ -76,3 +99,44 @@ class ReplicatedKVS:
                                  jnp.asarray(encode_cmd(OP_GET, key)))
         v = decode_val(np.asarray(out))
         return v if v else None
+
+
+class ClientSession:
+    """A client endpoint that may RETRANSMIT requests (after a timeout, a
+    reconnect, or a leader failover) — the reference's UD client whose
+    duplicates the leader drops via ``last_req_id``
+    (``dare_ep_db.h:20-30``, ``dare_ibv_ud.c:1004-1014``).
+
+    Every mutation is stamped ``(client_id, req_id)`` end-to-end: the pair
+    rides the entry's ``M_CONN``/``M_REQID`` columns through the log, and
+    every replica's fold skips any request at-or-below the client's
+    applied high-water mark — so a duplicate appended by ANY leader (the
+    one that crashed after committing, or the new one the client retried
+    against) applies exactly once, in first-commit order."""
+
+    def __init__(self, kvs: ReplicatedKVS, client_id: int):
+        if client_id <= 0:
+            raise ValueError("client_id must be positive")
+        self.kvs = kvs
+        self.client_id = client_id
+        self.req_id = 0
+
+    def put(self, leader: int, key: bytes, val: bytes) -> int:
+        """Submit a PUT; returns its req_id (keep it to retransmit)."""
+        self.req_id += 1
+        self.kvs.put(leader, key, val, client_id=self.client_id,
+                     req_id=self.req_id)
+        return self.req_id
+
+    def remove(self, leader: int, key: bytes) -> int:
+        self.req_id += 1
+        self.kvs.remove(leader, key, client_id=self.client_id,
+                        req_id=self.req_id)
+        return self.req_id
+
+    def retransmit_put(self, leader: int, key: bytes, val: bytes,
+                       req_id: int) -> None:
+        """Resend an earlier PUT verbatim (client saw no ack — e.g. the
+        leader died after commit). Safe to call any number of times."""
+        self.kvs.put(leader, key, val, client_id=self.client_id,
+                     req_id=req_id)

@@ -25,8 +25,24 @@ Two interchangeable implementations:
 
 Both are pure element-wise/reduction code on a ``[W, R_PAD]`` tile, so XLA
 also fuses the reference version well; the kernel exists to keep the scan in
-a single VMEM-resident pass and as the seed for fusing the whole
-ack-aggregate + commit-advance stage.
+a single VMEM-resident pass. Production paths (SimCluster,
+HostReplicaDriver) default to the Pallas kernel on TPU — the same code
+path as the benches.
+
+FUSION RESULT (measured, round 3): extending the kernel across the whole
+ack-aggregate + window-select + commit stage is a NULL result by
+construction and by measurement. The ack aggregate is a
+``lax.all_gather`` and the window select consumes another gather's
+output — cross-replica collectives that cannot live inside a
+single-replica Pallas kernel without remote DMAs; everything element-wise
+around them is already fused by XLA into the collectives' prologue/
+epilogue. Measured on TPU v5e (64-step scans, batch 1024, R=3): full
+step 479 µs with the Pallas scan vs 465 µs with the jnp scan — parity
+within run-to-run noise (~3%), confirming the scan tile ([W, 128] i32)
+is nowhere near the step's critical path (the window gather/scatter and
+ring scans are). The kernel is kept as the single-VMEM-pass form and the
+seed for a future multi-chip kernel that overlaps the quorum scan with
+the window DMA.
 """
 
 from __future__ import annotations

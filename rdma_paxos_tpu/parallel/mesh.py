@@ -98,10 +98,13 @@ def build_sim_burst(cfg: LogConfig, n_replicas: int, *,
     No elections fire inside a burst (timeouts forced 0; every scan step
     carries the leader heartbeat), so the burst compiles the STABLE step
     (``elections=False`` — Phase B could only ever be a no-op; statically
-    removing it drops one collective per scan step). The host apply echo
-    is folded into the carry so pruning frees ring space mid-burst. K is
-    the leading axis of the stacked inputs; returns the final state plus
-    per-step stacked outputs for exact host accounting."""
+    removing it drops one collective per scan step). The host apply
+    cursors are frozen across the burst (the host cannot replay
+    mid-burst), so pruning advances at most to the pre-burst applied
+    offsets; the caller's capacity sizing must fit the whole burst in
+    the pre-burst free space. K is the leading axis of the stacked
+    inputs; returns the final state plus per-step stacked outputs for
+    exact host accounting."""
     import jax.numpy as jnp
     from jax import lax
 
@@ -112,14 +115,17 @@ def build_sim_burst(cfg: LogConfig, n_replicas: int, *,
     vstep = jax.vmap(core, in_axes=(0, 0), axis_name=REPLICA_AXIS)
     zeros_r = jnp.zeros((n_replicas,), jnp.int32)
 
-    def burst(state_b, datas, metas, counts, peer_mask):
-        # datas [K, R, B, sw]; metas [K, R, B, MW]; counts [K, R]
+    def burst(state_b, datas, metas, counts, peer_mask, applied):
+        # datas [K, R, B, sw]; metas [K, R, B, MW]; counts [K, R];
+        # applied [R] = the HOST's true apply cursors, frozen across the
+        # burst — echoing st.commit here would let pressure-gated (and
+        # forced) pruning recycle slots the host has not replayed yet
         def body(st, xs):
             d, m, c = xs
             inp = StepInput(
                 batch_data=d, batch_meta=m, batch_count=c,
                 timeout_fired=zeros_r, peer_mask=peer_mask,
-                apply_done=st.commit)
+                apply_done=applied)
             st, out = vstep(st, inp)
             return st, out
         return lax.scan(body, state_b, (datas, metas, counts))
@@ -139,7 +145,8 @@ def build_spmd_burst(cfg: LogConfig, n_replicas: int, mesh: Mesh, *,
         axis_name=REPLICA_AXIS, use_pallas=use_pallas, interpret=interpret,
         fanout=fanout, elections=False)
 
-    def per_device(state_b, datas_b, metas_b, counts_b, peer_b):
+    def per_device(state_b, datas_b, metas_b, counts_b, peer_b,
+                   applied_b):
         st = _squeeze(state_b)
 
         def body(s, xs):
@@ -147,7 +154,7 @@ def build_spmd_burst(cfg: LogConfig, n_replicas: int, mesh: Mesh, *,
             inp = StepInput(
                 batch_data=d[0], batch_meta=m[0], batch_count=c[0],
                 timeout_fired=jnp.zeros((), jnp.int32),
-                peer_mask=peer_b[0], apply_done=s.commit)
+                peer_mask=peer_b[0], apply_done=applied_b[0])
             s, out = core(s, inp)
             return s, out
         st, outs = lax.scan(body, st, (datas_b, metas_b, counts_b))
@@ -158,7 +165,7 @@ def build_spmd_burst(cfg: LogConfig, n_replicas: int, mesh: Mesh, *,
         per_device, mesh=mesh,
         in_specs=(P(REPLICA_AXIS), P(None, REPLICA_AXIS),
                   P(None, REPLICA_AXIS), P(None, REPLICA_AXIS),
-                  P(REPLICA_AXIS)),
+                  P(REPLICA_AXIS), P(REPLICA_AXIS)),
         out_specs=(P(REPLICA_AXIS), P(None, REPLICA_AXIS)),
         check_vma=False)
     return jax.jit(mapped, donate_argnums=(0,) if donate else ())

@@ -17,6 +17,7 @@ The shim ↔ driver wire protocol is defined in ``native/interpose.cpp``.
 
 from __future__ import annotations
 
+import functools
 import os
 import socket
 import struct
@@ -37,35 +38,70 @@ _OP_TO_ETYPE = {
 
 @dataclass
 class PendingEvent:
-    """One shim event awaiting commit (the blocked app thread's handle)."""
+    """One shim event awaiting commit (the blocked app thread's handle).
+
+    Two completion surfaces: ``done`` (a threading.Event for in-process
+    waiters) and an optional ``on_done`` callback the ProxyServer
+    attaches to send the seq-tagged wire response — the pipelined-shim
+    contract, where the link thread never blocks on a commit."""
 
     etype: EntryType
     conn_id: int
     payload: bytes
     done: threading.Event = field(default_factory=threading.Event)
     status: int = 0
+    on_done: Optional[Callable[[int], None]] = None
+    _cb_lock: threading.Lock = field(default_factory=threading.Lock)
 
     def release(self, status: int = 0) -> None:
         self.status = status
         self.done.set()
+        self._fire()
+
+    def attach(self, cb: Callable[[int], None]) -> None:
+        """Attach the wire-response callback (fires immediately if the
+        event already completed — release/attach may race)."""
+        with self._cb_lock:
+            self.on_done = cb
+        if self.done.is_set():
+            self._fire()
+
+    def _fire(self) -> None:
+        with self._cb_lock:
+            if not self.done.is_set() or self.on_done is None:
+                return
+            cb, self.on_done = self.on_done, None
+        try:
+            cb(self.status)
+        except OSError:
+            pass                     # link died: the shim fell back
 
 
 class ProxyServer:
     """Unix-socket server the interposed app connects to.
 
-    One thread per app link; events on a link are strictly serialized by
-    the shim's mutex, so the link thread reads an event, hands it to the
-    driver-provided ``on_event`` callback, waits for release if deferred,
-    and writes the status back.
+    One thread per app link. The link thread only READS: each event is
+    handed to the driver-provided ``on_event`` callback, and the
+    seq-tagged response is written either immediately (pass-through /
+    sever verdicts) or from whatever thread releases the PendingEvent
+    once the entry commits — so many app threads can have events in
+    flight concurrently (the reference's tailq-insert-then-spin split,
+    ``proxy.c:114-160``). Per-fd event order is preserved end-to-end:
+    the shim serializes writes under its send mutex and this server
+    reads them in order into the driver's submit queue.
     """
 
     def __init__(self, sock_path: str, node_id: int,
                  on_event: Callable[[int, int, bytes],
-                                    Optional[PendingEvent]]):
+                                    Optional[PendingEvent]],
+                 conn_ctr_start: int = 0):
         self.sock_path = sock_path
         self.node_id = node_id
         self.on_event = on_event
-        self._conn_ctr = 0
+        # namespaced start (elastic generations) so a restarted host's
+        # fresh connection ids cannot collide with ids its previous
+        # incarnation stamped into carried-over log entries
+        self._conn_ctr = conn_ctr_start & 0xFFFFFF
         self.conn_of_fd: Dict[Tuple[int, int], int] = {}  # (link, fd) -> id
         if os.path.exists(sock_path):
             os.unlink(sock_path)
@@ -105,35 +141,41 @@ class ProxyServer:
         return buf
 
     def _serve_link(self, link: socket.socket, lid: int) -> None:
+        wlock = threading.Lock()     # responses come from many threads
+
+        def respond(seq: int, status: int) -> None:
+            with wlock:
+                link.sendall(struct.pack("<Ii", seq, status))
+
         try:
             while not self._stop.is_set():
-                hdr = self._recv_exact(link, 9)
+                hdr = self._recv_exact(link, 13)
                 if hdr is None:
                     return
-                op, fd, ln = struct.unpack("<BiI", hdr)
+                op, seq, fd, ln = struct.unpack("<BIiI", hdr)
                 payload = self._recv_exact(link, ln) if ln else b""
                 if payload is None:
                     return
-                status = 0
-                if op == OP_HELLO:
-                    pass
-                elif op in _OP_TO_ETYPE:
-                    if op == OP_CONNECT:
-                        self.conn_of_fd[(lid, fd)] = self.next_conn_id()
-                    conn_id = self.conn_of_fd.get((lid, fd), 0)
-                    if op == OP_CLOSE:
-                        self.conn_of_fd.pop((lid, fd), None)
-                    # handler returns: None => pass through (0);
-                    # int => immediate status (<0 severs the connection);
-                    # PendingEvent => block until committed
-                    ev = self.on_event(int(_OP_TO_ETYPE[op]), conn_id,
-                                       payload)
-                    if isinstance(ev, PendingEvent):
-                        ev.done.wait()
-                        status = ev.status
-                    elif isinstance(ev, int):
-                        status = ev
-                link.sendall(struct.pack("<i", status))
+                if op not in _OP_TO_ETYPE:       # HELLO / unknown
+                    respond(seq, 0)
+                    continue
+                if op == OP_CONNECT:
+                    self.conn_of_fd[(lid, fd)] = self.next_conn_id()
+                conn_id = self.conn_of_fd.get((lid, fd), 0)
+                if op == OP_CLOSE:
+                    self.conn_of_fd.pop((lid, fd), None)
+                # handler returns: None => pass through (0);
+                # int => immediate status (<0 severs the connection);
+                # PendingEvent => respond when committed (the link
+                # thread moves on to the next event immediately)
+                ev = self.on_event(int(_OP_TO_ETYPE[op]), conn_id,
+                                   payload)
+                if isinstance(ev, PendingEvent):
+                    ev.attach(functools.partial(respond, seq))
+                elif isinstance(ev, int):
+                    respond(seq, ev)
+                else:
+                    respond(seq, 0)
         except OSError:
             pass
         finally:

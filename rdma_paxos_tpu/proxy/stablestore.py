@@ -18,6 +18,25 @@ _LIB_PATH = os.path.join(_NATIVE_DIR, "libstablestore.so")
 _lib: Optional[ctypes.CDLL] = None
 
 
+def atomic_write(path: str, data: bytes) -> None:
+    """Crash-safe whole-file write: tmp + fsync + rename + parent-dir
+    fsync — a crash at any point leaves either the old complete file or
+    the new complete file, never a mix. The single implementation for
+    every durable control file (HardState, elastic recovery dumps)."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    dfd = os.open(os.path.dirname(os.path.abspath(path)) or ".",
+                  os.O_RDONLY)
+    try:
+        os.fsync(dfd)
+    finally:
+        os.close(dfd)
+
+
 def _load() -> ctypes.CDLL:
     global _lib
     if _lib is not None:
@@ -83,20 +102,7 @@ class HardState:
         tup = (int(term), int(voted_term), int(voted_for))
         if tup == self._last:
             return
-        tmp = self.path + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(np.array(tup, "<i8").tobytes())
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self.path)
-        # fsync the parent directory so the rename itself survives power
-        # loss (otherwise the new file may be lost with the old unlinked)
-        dfd = os.open(os.path.dirname(os.path.abspath(self.path)) or ".",
-                      os.O_RDONLY)
-        try:
-            os.fsync(dfd)
-        finally:
-            os.close(dfd)
+        atomic_write(self.path, np.array(tup, "<i8").tobytes())
         self._last = tup
 
     def load(self):

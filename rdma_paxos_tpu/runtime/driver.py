@@ -402,6 +402,7 @@ class ClusterDriver:
     def _apply_new_entries(self, r: int, rt: _ReplicaRuntime) -> None:
         stream = self.cluster.replayed[r]
         progressed = rt.replay_cursor < len(stream)
+        releases = []
         while rt.replay_cursor < len(stream):
             etype, conn, req, payload = stream[rt.replay_cursor]
             rt.replay_cursor += 1
@@ -419,12 +420,17 @@ class ClusterDriver:
                 with self._lock:
                     while rt.inflight and rt.inflight[0][1] <= req:
                         ev, _ = rt.inflight.popleft()
-                        ev.release(0)
+                        releases.append(ev)
         if progressed:
             if rt.replay is not None:
                 rt.replay.drain_responses()
             if rt.store is not None:
+                # persist BEFORE acking (persist_new_entries precedes
+                # apply/ack in the reference): a client ack implies the
+                # event reached this replica's stable store
                 rt.store.sync()
+        for ev in releases:
+            ev.release(0)
 
     # ------------------------------------------------------------------
     # lifecycle

@@ -1,0 +1,162 @@
+"""Elastic generation worker — one per host per generation.
+
+Runs the ordinary :class:`~rdma_paxos_tpu.runtime.node.NodeDaemon`
+lock-step loop inside the generation's own ``jax.distributed`` world,
+bracketed by the elastic machinery of :mod:`.elastic`:
+
+* boots from the generation's GENESIS row (donor state sanitized by
+  :func:`~rdma_paxos_tpu.consensus.snapshot.genesis_row`) when the spec
+  names a donor, else fresh;
+* rebuilds the local app by replaying the (donor-derived) stable store;
+* between rounds of ``--round-iters`` iterations, dumps a consistent
+  (state row, store blob, meta) recovery triple and posts the
+  controller's round barrier — ``ok=0`` means the world is being rebuilt
+  and this worker exits cleanly;
+* on ANY collective error (a peer died mid-round) the last barrier dump
+  on disk is the recovery point; the worker exits nonzero and the
+  supervisor reports the failure.
+
+Exit codes: 0 = clean generation end; nonzero = collective/peer failure.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import sys
+import time
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--spec", required=True)
+    ap.add_argument("--workdir", required=True)
+    ap.add_argument("--host-id", type=int, required=True)
+    ap.add_argument("--controller", required=True)
+    ap.add_argument("--app-port", type=int, default=0)
+    ap.add_argument("--round-iters", type=int, default=25)
+    ap.add_argument("--cfg-json", default="")
+    args = ap.parse_args()
+
+    with open(args.spec) as f:
+        spec = json.load(f)
+    members = [m["host"] for m in spec["members"]]
+    slot = members.index(args.host_id)
+    M = len(members)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.pop("XLA_FLAGS", None)     # one device per process
+    import jax
+    if os.environ.get("RP_BENCH_CPU", "1") == "1":
+        jax.config.update("jax_platforms", "cpu")
+
+    from rdma_paxos_tpu.config import LogConfig, TimeoutConfig
+    from rdma_paxos_tpu.consensus.snapshot import genesis_row
+    from rdma_paxos_tpu.runtime.elastic import call, write_dump
+    from rdma_paxos_tpu.runtime.node import NodeDaemon
+
+    if args.cfg_json:
+        raw = json.loads(args.cfg_json)
+        cfg = LogConfig(**raw.get("log", {}))
+        timing = TimeoutConfig(**raw.get("timing", {}))
+    else:
+        cfg = LogConfig(n_slots=1024, slot_bytes=256, window_slots=64,
+                        batch_slots=64)
+        timing = TimeoutConfig(elec_timeout_low=0.5, elec_timeout_high=1.0)
+
+    genesis = None
+    if int(spec["donor"]) >= 0:
+        import numpy as np
+        base = os.path.join(args.workdir, f"gen{spec['gen']}_donor")
+        with np.load(f"{base}_row_h{args.host_id}.npz") as z:
+            donor_row = {k: z[k] for k in z.files}
+        with open(f"{base}_meta_h{args.host_id}.json") as f:
+            donor_meta = json.load(f)
+        genesis = genesis_row(
+            donor_row, group_mask=(1 << M) - 1, epoch=int(spec["epoch"]),
+            n_replicas=M, term=int(spec["term_base"]))
+        # the store blob matches the donor's HOST applied counter (the
+        # device-row apply can lag it by the final iteration's window);
+        # raise apply to the store's high-water mark so no member
+        # re-applies — and so re-appends — records already in the store
+        genesis["apply"] = np.int32(int(donor_meta["applied"]))
+
+    node = NodeDaemon(
+        cfg, process_id=slot, num_processes=M,
+        coordinator=spec["coordinator"], workdir=args.workdir,
+        app_port=args.app_port or None, timeout_cfg=timing,
+        host_id=args.host_id, genesis=genesis,
+        seed=spec["gen"] * 1000, gen=int(spec["gen"]))
+
+    if args.app_port:
+        # the supervisor starts the app once our proxy socket exists;
+        # wait until it accepts before replaying history into it
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            try:
+                socket.create_connection(("127.0.0.1", args.app_port),
+                                         timeout=2).close()
+                break
+            except OSError:
+                time.sleep(0.1)
+    node.bootstrap_from_store()
+
+    gen, rnd = int(spec["gen"]), 0
+    # Per-iteration stash: after every COMPLETED iteration, keep the
+    # state row + meta in memory. On a mid-round collective failure (a
+    # peer died), the live store sits exactly at the stashed iteration
+    # (the failing step never reached its apply phase), so the pair is a
+    # CONSISTENT recovery point that includes every write acked so far —
+    # this is what makes "acked writes survive any tolerated failure"
+    # true even for failures between round barriers.
+    stash_row = stash_meta = None
+    try:
+        while True:
+            for _ in range(args.round_iters):
+                node.iterate()
+                stash_row = node.dump_row()
+                stash_meta = node.meta(stash_row)
+                stash_meta.update(gen=gen, round=rnd,
+                                  host=args.host_id)
+            write_dump(args.workdir, args.host_id, stash_row,
+                       node.store.dump(), stash_meta)
+            try:
+                resp, _ = call(
+                    args.controller,
+                    {"op": "round", "host": args.host_id,
+                     "gen": gen, "round": rnd},
+                    # must outlive the controller's barrier budget
+                    timeout=float(spec.get("barrier_timeout", 120)) + 60)
+            except (OSError, ConnectionError):
+                resp = {"ok": 0}
+            if not resp.get("ok"):
+                break
+            rnd += 1
+    except Exception:
+        import traceback
+        traceback.print_exc()
+        # dump the stash UNLESS the failure hit the apply phase (then
+        # the live store may be mid-iteration, ahead of the stashed row
+        # — fall back to the last barrier dump already on disk)
+        if stash_row is not None and node.phase == "step":
+            try:
+                write_dump(args.workdir, args.host_id, stash_row,
+                           node.store.dump(), stash_meta)
+            except Exception:
+                traceback.print_exc()
+        # exit hard so the wedged distributed runtime cannot block us
+        # (its shutdown barrier would abort anyway once a peer is gone)
+        sys.stdout.flush()
+        os._exit(1)
+    node.close()
+    # skip jax.distributed shutdown: peers may already be gone and the
+    # coordination-service shutdown barrier would turn a clean exit into
+    # an abort; the dump is already on disk
+    sys.stdout.flush()
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

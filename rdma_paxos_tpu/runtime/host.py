@@ -37,7 +37,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from rdma_paxos_tpu.config import LogConfig
 from rdma_paxos_tpu.consensus.log import (
-    EntryType, M_CONN, M_LEN, M_REQID, M_TYPE, META_W)
+    EntryType, M_CONN, M_GEN, M_LEN, M_REQID, M_TYPE, META_W)
 from rdma_paxos_tpu.consensus.step import StepInput, fetch_window
 from rdma_paxos_tpu.parallel.mesh import (
     REPLICA_AXIS, build_spmd_step, stack_states)
@@ -68,20 +68,20 @@ class HostReplicaDriver:
         # real deployments run full-connectivity meshes: the O(W) psum
         # fan-out is sound there (see replica_step's fanout docstring)
         self._fanout = fanout
-        self._step = build_spmd_step(cfg, self.R, self.mesh, fanout=fanout)
+        self._step = build_spmd_step(
+            cfg, self.R, self.mesh, fanout=fanout,
+            # same kernel as the benches: Pallas quorum scan on TPU
+            use_pallas=jax.default_backend() == "tpu")
 
-        def fetch(state_b, starts):
-            def per_dev(log_b, start_b):
-                wd, wm = fetch_window(
-                    jax.tree.map(lambda x: x[0], log_b), start_b[0],
-                    window_slots=cfg.window_slots)
-                return wd[None], wm[None]
-            return jax.shard_map(
-                per_dev, mesh=self.mesh,
-                in_specs=(P(REPLICA_AXIS), P(REPLICA_AXIS)),
-                out_specs=(P(REPLICA_AXIS), P(REPLICA_AXIS)),
-                check_vma=False)(state_b.log, starts)
-        self._fetch = jax.jit(fetch)
+        # HOST-LOCAL window fetch: reads THIS replica's log shard only —
+        # a single-device program outside the SPMD step, so hosts may
+        # call it independently (or not at all on idle iterations). The
+        # collective window fetch this replaces forced every host into a
+        # second lock-step program per iteration.
+        from rdma_paxos_tpu.consensus.log import Log as _Log
+        self._local_fetch = jax.jit(
+            lambda buf, start: fetch_window(
+                _Log(buf=buf), start, window_slots=cfg.window_slots))
 
         self.state = jax.device_put(stack_states(cfg, self.R, group_size
                                                  or self.R),
@@ -89,6 +89,33 @@ class HostReplicaDriver:
         self._local_dev = self.mesh.devices.flat[self.me]
 
     # ------------------------------------------------------------------
+
+    def install_genesis(self, row: dict) -> None:
+        """Install an identical pre-synchronized state row on EVERY
+        replica of the world — the elastic-rebuild boot path (see
+        ``consensus/snapshot.genesis_row``). Collective: every host calls
+        this at the same point with the SAME row (all fetched it from the
+        generation's donor)."""
+        import dataclasses as _dc
+        from rdma_paxos_tpu.consensus.log import Log
+        from rdma_paxos_tpu.consensus.state import ReplicaState
+
+        def put(leaf: np.ndarray) -> jax.Array:
+            shards = [jax.device_put(leaf[None], d)
+                      for d in self.mesh.devices.flat
+                      if d.process_index == jax.process_index()]
+            return jax.make_array_from_single_device_arrays(
+                (self.R,) + leaf.shape, self._sharding, shards)
+
+        fields = {}
+        for f in _dc.fields(ReplicaState):
+            if f.name == "log":
+                continue
+            cur = getattr(self.state, f.name)
+            fields[f.name] = put(np.asarray(row[f.name]).astype(cur.dtype))
+        fields["log"] = Log(buf=put(np.asarray(row["log_buf"],
+                                               np.int32)))
+        self.state = ReplicaState(**fields)
 
     def restore_hardstate(self, term: int, voted_term: int,
                           voted_for: int) -> None:
@@ -132,7 +159,8 @@ class HostReplicaDriver:
     def make_input(self, batch: Sequence[Tuple[int, int, int, bytes]] = (),
                    timeout_fired: bool = False,
                    apply_done: int = 0,
-                   peer_mask: Optional[np.ndarray] = None) -> StepInput:
+                   peer_mask: Optional[np.ndarray] = None,
+                   gen: int = 0) -> StepInput:
         cfg, B = self.cfg, self.cfg.batch_slots
         data = np.zeros((B, cfg.slot_words), np.int32)
         meta = np.zeros((B, META_W), np.int32)
@@ -142,6 +170,7 @@ class HostReplicaDriver:
             meta[i, M_CONN] = conn
             meta[i, M_REQID] = req
             meta[i, M_LEN] = len(payload)
+            meta[i, M_GEN] = gen
         if peer_mask is not None and self._fanout == "psum":
             # the psum fan-out is sound only under full connectivity: a
             # partition mask could leave two self-claimed leaders whose
@@ -183,15 +212,31 @@ class HostReplicaDriver:
             res[k] = np.asarray(local[0].data[0]) if local else None
         return res
 
+    def export_local_row(self) -> dict:
+        """THIS replica's full state row as host numpy (local shard reads
+        only — no collective), keyed like ``snapshot.export_row``. The
+        donor half of elastic world rebuild."""
+        import dataclasses as _dc
+        from rdma_paxos_tpu.consensus.state import ReplicaState
+
+        def local(arr):
+            sh = [s for s in arr.addressable_shards
+                  if s.index[0].start == self.me]
+            return np.asarray(sh[0].data[0])
+
+        out = {"log_buf": local(self.state.log.buf)}
+        for f in _dc.fields(ReplicaState):
+            if f.name != "log":
+                out[f.name] = local(getattr(self.state, f.name))
+        return out
+
     def fetch_local_window(self, start: int
                            ) -> Tuple[np.ndarray, np.ndarray]:
         """Read ``window_slots`` entries beginning at ``start`` from THIS
-        replica's log (collective call — every host calls with its own
-        start)."""
-        starts = self._global_from_local(np.asarray(start, np.int32))
-        wd, wm = self._fetch(self.state, starts)
-        ld = [s for s in wd.addressable_shards
+        replica's log. Host-local (no collective): call freely, on any
+        host, only when needed."""
+        sh = [s for s in self.state.log.buf.addressable_shards
               if s.index[0].start == self.me][0]
-        lm = [s for s in wm.addressable_shards
-              if s.index[0].start == self.me][0]
-        return np.asarray(ld.data[0]), np.asarray(lm.data[0])
+        wd, wm = self._local_fetch(sh.data[0],
+                                   jnp.asarray(start, jnp.int32))
+        return np.asarray(wd), np.asarray(wm)

@@ -6,12 +6,17 @@ host's slice of the distributed consensus state (one replica on the local
 chip), the proxy socket its interposed app connects to, the loopback replay
 engine, the stable store, and the election timer.
 
-Lock-step discipline: every loop iteration issues exactly TWO collective
-programs in fixed order — the protocol step, then one window fetch — so
-all hosts stay SPMD-consistent regardless of how their local values differ.
-Hosts synchronize through the collectives themselves (a host that runs
-ahead blocks in the next collective until peers arrive), exactly as the
-reference's followers synchronize through RDMA completion semantics.
+Lock-step discipline: every loop iteration issues exactly ONE collective
+program — the protocol step — so all hosts stay SPMD-consistent
+regardless of how their local values differ; the committed-window fetch
+is HOST-LOCAL (it reads only this replica's log shard) and runs only on
+iterations where commit advanced. Hosts synchronize through the step's
+collectives themselves (a host that runs ahead blocks in the next step
+until peers arrive), exactly as the reference's followers synchronize
+through RDMA completion semantics. A watchdog stamps a warning into the
+replica log when one iteration stalls far beyond the cadence — the
+symptom of a desynced or dead peer (the elastic supervisor reacts by
+regenerating the world; see runtime/elastic.py).
 """
 
 from __future__ import annotations
@@ -25,7 +30,7 @@ import numpy as np
 
 from rdma_paxos_tpu.config import ClusterConfig, LogConfig, TimeoutConfig
 from rdma_paxos_tpu.consensus.log import (
-    EntryType, M_CONN, M_LEN, M_REQID, M_TYPE)
+    EntryType, M_CONN, M_GEN, M_LEN, M_REQID, M_TYPE)
 from rdma_paxos_tpu.consensus.state import Role
 from rdma_paxos_tpu.proxy.proxy import PendingEvent, ProxyServer, ReplayEngine
 from rdma_paxos_tpu.proxy.stablestore import HardState, StableStore
@@ -40,29 +45,56 @@ class NodeDaemon:
                  num_processes: int, coordinator: str,
                  workdir: str, app_port: Optional[int] = None,
                  timeout_cfg: Optional[TimeoutConfig] = None,
-                 group_size: Optional[int] = None, seed: int = 0):
+                 group_size: Optional[int] = None, seed: int = 0,
+                 host_id: Optional[int] = None,
+                 genesis: Optional[dict] = None, gen: int = 0):
         self.cfg = cfg
         self.me = process_id
+        # elastic generation number: namespaces this incarnation's
+        # submit sequence (req stamps) and connection counters, so log
+        # entries carried over from a PREVIOUS incarnation of this same
+        # host can neither falsely ack this incarnation's inflight
+        # events nor be mistaken for events this incarnation's app
+        # already served (they must be REPLAYED into the rebuilt app)
+        self.gen = gen
+        # persistent host identity: stamps connection origins (conn_id >>
+        # 24), so replay-vs-ack decisions survive slot renumbering across
+        # elastic generations (process_id is the SLOT in this world; the
+        # host_id is forever)
+        self.host_id = process_id if host_id is None else host_id
         self.hd = HostReplicaDriver(
             cfg, process_id=process_id, num_processes=num_processes,
             coordinator=coordinator, group_size=group_size)
+        if genesis is not None:
+            # elastic world rebuild: every member installs the identical
+            # donor-derived row (collective — all daemons of the
+            # generation pass a genesis or none do)
+            self.hd.install_genesis(genesis)
         os.makedirs(workdir, exist_ok=True)
         self._lock = threading.Lock()
         self._is_leader = False
         self._submitq: List[Tuple[int, int, bytes, int]] = []
         self.inflight: collections.deque = collections.deque()
-        self.submit_seq = 0
-        self.applied = 0
+        self.submit_seq = 0   # per-incarnation; entries carry M_GEN so
+                              # cross-incarnation req compares never happen
+        self.applied = int(genesis["apply"]) if genesis is not None else 0
+        self.needs_recovery = False   # force-pruned past our apply cursor
+        self.phase = "idle"           # "step" | "apply" (crash forensics)
         self.replicated_conns: set = set()
         self.passthrough_conns: set = set()
         self.sock_path = os.path.join(workdir, f"proxy{self.me}.sock")
-        self.proxy = ProxyServer(self.sock_path, self.me, self._on_event)
+        self.proxy = ProxyServer(self.sock_path, self.host_id,
+                                 self._on_event,
+                                 conn_ctr_start=(gen % 16) << 20)
         self.replay = (ReplayEngine("127.0.0.1", app_port)
                        if app_port else None)
+        # stable files are keyed by the PERSISTENT host id: a restarted
+        # host finds its own history regardless of which slot the new
+        # generation assigns it
         self.store = StableStore(
-            os.path.join(workdir, f"replica{self.me}.db"))
+            os.path.join(workdir, f"host{self.host_id}.db"))
         self.hard = HardState(
-            os.path.join(workdir, f"replica{self.me}.db.hs"))
+            os.path.join(workdir, f"host{self.host_id}.db.hs"))
         # a RESTARTED daemon restores its persisted election state so it
         # cannot double-vote in a term it voted in before the crash
         # (collective — every daemon calls this during init, with zeros
@@ -127,8 +159,15 @@ class NodeDaemon:
             fire = True
             self.timer.beat()
 
+        # phase marker for crash-dump consistency: an exception in the
+        # "step" phase leaves the store exactly at the previous
+        # iteration's state (safe to pair with a stashed row); an
+        # exception mid-"apply" does not (the caller falls back to its
+        # last barrier dump)
+        self.phase = "step"
         res = self.hd.step(batch=batch, timeout_fired=fire,
-                           apply_done=self.applied)
+                           apply_done=self.applied, gen=self.gen)
+        self.phase = "apply"
         self.hard.save(int(res["term"]), int(res["voted_term"]),
                        int(res["voted_for"]))
         was_leader = self._is_leader
@@ -139,11 +178,28 @@ class NodeDaemon:
         if res["hb_seen"] or self._is_leader:
             self.timer.beat()
 
-        # fixed single fetch per iteration (SPMD-uniform)
-        wd, wm = self.hd.fetch_local_window(self.applied)
+        # window fetch only when commit advanced — host-local (reads our
+        # own log shard), so skipping it on idle iterations is legal:
+        # the step above is the iteration's ONLY collective program
         commit = int(res["commit"])
         n = min(commit - self.applied, self.cfg.window_slots)
         progressed = n > 0
+        if progressed:
+            wd, wm = self.hd.fetch_local_window(self.applied)
+            from rdma_paxos_tpu.consensus.log import M_GIDX
+            if int(wm[0, M_GIDX]) != self.applied:
+                # our slot was recycled (forced pruning left this host
+                # behind): recycled bytes must never reach the app —
+                # stop applying and wait for recovery (the elastic
+                # supervisor rebuilds us from a donor snapshot)
+                if not self.needs_recovery:
+                    self.needs_recovery = True
+                    self.log.info_wtime(
+                        "PRUNED past apply cursor %d — snapshot "
+                        "recovery required" % self.applied)
+                n = 0
+                progressed = False
+        releases = []
         for j in range(max(n, 0)):
             etype = int(wm[j, M_TYPE])
             if etype in (int(EntryType.CONNECT), int(EntryType.SEND),
@@ -154,19 +210,29 @@ class NodeDaemon:
                 payload = wd[j].astype("<i4").tobytes()[:ln]
                 self.store.append(bytes([etype])
                                   + conn.to_bytes(4, "little") + payload)
-                if (conn >> 24) != self.me:
-                    if self.replay is not None:
-                        self.replay.apply(etype, conn, payload)
-                else:
+                # "our own event" means THIS incarnation's (M_GEN column
+                # matches our generation): its app thread already
+                # consumed the bytes live — ack it. An entry from a
+                # previous incarnation of this host is replayed like a
+                # remote one: the rebuilt app has never seen it.
+                if ((conn >> 24) == self.host_id
+                        and int(wm[j, M_GEN]) == self.gen):
                     with self._lock:
                         while self.inflight and self.inflight[0][1] <= req:
                             ev, _ = self.inflight.popleft()
-                            ev.release(0)
+                            releases.append(ev)
+                elif self.replay is not None:
+                    self.replay.apply(etype, conn, payload)
         self.applied += max(n, 0)
         if progressed:
             if self.replay is not None:
                 self.replay.drain_responses()
+            # persist BEFORE acking (the reference's persist_new_entries
+            # precedes apply/ack): a client ack implies the event is in
+            # this host's stable store
             self.store.sync()
+        for ev in releases:
+            ev.release(0)
         if not self._is_leader:
             with self._lock:
                 while self.inflight:
@@ -175,12 +241,61 @@ class NodeDaemon:
         self.last = res
         return res
 
-    def run_iterations(self, n: int, period: float = 0.0) -> None:
+    def bootstrap_from_store(self) -> None:
+        """Rebuild a FRESH local app instance by replaying the stable
+        store's full event history into it (the joiner's
+        ``proxy_apply_db_snapshot`` analog, ``proxy.c:306-339``). Call
+        once at generation start, before the first ``iterate`` — the
+        supervisor restarts the app, this fills it."""
+        if self.replay is None:
+            return
+        for i in range(len(self.store)):
+            rec = self.store.read(i)
+            etype, conn = rec[0], int.from_bytes(rec[1:5], "little")
+            self.replay.apply(etype, conn, rec[5:])
+        self.replay.drain_responses()
+
+    def dump_row(self) -> dict:
+        """THIS replica's full consensus state row (host numpy) — what
+        the supervisor persists at generation exit and serves to the next
+        generation's members if elected donor."""
+        return self.hd.export_local_row()
+
+    def meta(self, row: Optional[dict] = None) -> Dict[str, int]:
+        """Donor-election metadata: Raft's up-to-date ordering key plus
+        progress offsets (the controller picks the donor by
+        ``(last_log_term, end)`` — Leader Completeness). Pass a
+        pre-exported ``row`` to avoid a second device read."""
+        from rdma_paxos_tpu.consensus.log import M_TERM
+        if row is None:
+            row = self.dump_row()
+        end = int(row["end"])
+        lterm = 0
+        if end > 0:
+            slot = (end - 1) & (self.cfg.n_slots - 1)
+            lterm = int(row["log_buf"][slot,
+                                       self.cfg.slot_words + M_TERM])
+        return dict(term=int(row["term"]), last_log_term=lterm,
+                    end=end, commit=int(row["commit"]),
+                    apply=int(row["apply"]), applied=self.applied,
+                    leader=int(self._is_leader))
+
+    def run_iterations(self, n: int, period: float = 0.0,
+                       watchdog_secs: float = 60.0) -> None:
         """Run exactly ``n`` lock-step iterations (every host must use the
-        same ``n`` — collective programs must match across hosts)."""
+        same ``n`` — collective programs must match across hosts). An
+        iteration blocked in the step's collectives for more than
+        ``watchdog_secs`` (compiles excluded by using the post-first-
+        iteration baseline) logs a desync warning."""
         import time
-        for _ in range(n):
+        for i in range(n):
+            t0 = time.monotonic()
             self.iterate()
+            dt = time.monotonic() - t0
+            if i > 0 and dt > watchdog_secs:
+                self.log.info_wtime(
+                    f"WATCHDOG: iteration blocked {dt:.1f}s — peer "
+                    "desync or death suspected")
             if period:
                 time.sleep(period)
 

@@ -20,7 +20,7 @@ import numpy as np
 
 from rdma_paxos_tpu.config import LogConfig
 from rdma_paxos_tpu.consensus.log import (
-    EntryType, M_CONN, M_LEN, M_REQID, M_TYPE, META_W)
+    EntryType, M_CONN, M_GIDX, M_LEN, M_REQID, M_TYPE, META_W)
 from rdma_paxos_tpu.consensus.state import Role
 from rdma_paxos_tpu.consensus.step import StepInput, fetch_window
 from rdma_paxos_tpu.parallel.mesh import (
@@ -38,12 +38,17 @@ class SimCluster:
 
     def __init__(self, cfg: LogConfig, n_replicas: int,
                  group_size: Optional[int] = None, *, mode: str = "sim",
-                 use_pallas: bool = False, interpret: bool = False,
+                 use_pallas: Optional[bool] = None,
+                 interpret: bool = False,
                  fanout: str = "gather", stable_fast_path: bool = True):
         self.cfg = cfg
         self.R = n_replicas
         self.group_size = group_size or n_replicas
         self._mode = mode
+        # production default: the Pallas quorum kernel on TPU (same code
+        # path as the benches), jnp reference scan elsewhere
+        if use_pallas is None:
+            use_pallas = jax.default_backend() == "tpu"
         self._use_pallas = use_pallas
         self._interpret = interpret
         self._fanout = fanout
@@ -64,9 +69,11 @@ class SimCluster:
                     self.mesh, jax.sharding.PartitionSpec("replica")))
         else:
             self._step = self._build_step(elections=True)
-        self._fetch = jax.jit(
+        # all replicas' windows in ONE dispatch (the per-replica loop of
+        # fetch+slice dispatches dominated the host replay path)
+        self._fetch_all = jax.jit(jax.vmap(
             lambda log, start: fetch_window(log, start,
-                                            window_slots=cfg.window_slots))
+                                            window_slots=cfg.window_slots)))
         # host bookkeeping
         self.applied = np.zeros(n_replicas, np.int64)   # host apply cursor
         self.peer_mask = np.ones((n_replicas, n_replicas), np.int32)
@@ -78,6 +85,11 @@ class SimCluster:
         # (type, conn_id, req_id, payload) per replica, in apply order
         self.replayed: List[List[Tuple[int, int, int, bytes]]] = [
             [] for _ in range(n_replicas)]
+        # replicas whose log was force-pruned past their apply cursor
+        # (force_log_pruning left them behind): replay stops — recycled
+        # slots must never reach the app — until snapshot recovery
+        self.need_recovery: set = set()
+        self._wedged: set = set()     # test hook: frozen apply (wedged app)
 
     # ---------------- client-side API ----------------
 
@@ -107,6 +119,15 @@ class SimCluster:
 
     def heal(self) -> None:
         self.peer_mask[:] = 1
+
+    def wedge_apply(self, r: int) -> None:
+        """Freeze replica ``r``'s apply progress (models a wedged app:
+        the host stops consuming committed entries while the replica
+        keeps acking windows)."""
+        self._wedged.add(r)
+
+    def unwedge_apply(self, r: int) -> None:
+        self._wedged.discard(r)
 
     # ---------------- stepping ----------------
 
@@ -207,7 +228,8 @@ class SimCluster:
         fn = self._burst_fn(K)
         self.state, outs = fn(self.state, jnp.asarray(data),
                               jnp.asarray(meta), jnp.asarray(count),
-                              jnp.asarray(self.peer_mask))
+                              jnp.asarray(self.peer_mask),
+                              jnp.asarray(self.applied.astype(np.int32)))
         res = {k: np.asarray(getattr(outs, k))[-1]
                for k in ("term", "role", "leader_id", "voted_term",
                          "voted_for", "head", "apply", "commit", "end",
@@ -283,19 +305,36 @@ class SimCluster:
         """Host apply loop: fetch newly committed entries from the device
         log and 'replay' them (tests record them; the real driver hands
         them to the proxy) — apply_committed_entries analog
-        (dare_server.c:1815-1974)."""
+        (dare_server.c:1815-1974). All replicas' windows ride ONE device
+        dispatch per sweep."""
         W = self.cfg.window_slots
-        for r in range(self.R):
-            commit = int(res["commit"][r])
-            if self.applied[r] >= commit:
-                continue
-            log_r = jax.tree.map(lambda x, r=r: x[r], self.state.log)
-            while self.applied[r] < commit:
-                start = int(self.applied[r])
-                n = min(commit - start, W)
-                wd, wm = self._fetch(log_r, jnp.asarray(start, jnp.int32))
-                wd, wm = np.asarray(wd), np.asarray(wm)
-                for j in range(n):
+        # Force-pruned laggards: when the ring no longer PHYSICALLY holds
+        # entry `applied` (a newer entry recycled its slot — possible
+        # once forced pruning let appends run ahead of a wedged member's
+        # apply), replaying would feed garbage to the app. The stamped
+        # global index (M_GIDX) proves integrity: fetched-entry gidx ==
+        # expected index, else flag for snapshot recovery and stop.
+        # Being merely below `head` is NOT sufficient to flag — the
+        # benign one-step lazy-push lag puts followers there routinely
+        # while their slots are still intact.
+        while True:
+            todo = [r for r in range(self.R)
+                    if r not in self._wedged
+                    and r not in self.need_recovery
+                    and self.applied[r] < int(res["commit"][r])]
+            if not todo:
+                return
+            starts = jnp.asarray(self.applied.astype(np.int32))
+            wd_all, wm_all = self._fetch_all(self.state.log, starts)
+            wd_all, wm_all = np.asarray(wd_all), np.asarray(wm_all)
+            for r in todo:
+                commit = int(res["commit"][r])
+                n = min(commit - self.applied[r], W)
+                wd, wm = wd_all[r], wm_all[r]
+                if n > 0 and int(wm[0, M_GIDX]) != self.applied[r]:
+                    self.need_recovery.add(r)       # slot recycled
+                    continue
+                for j in range(int(n)):
                     t = int(wm[j, M_TYPE])
                     if t in (int(EntryType.CONNECT), int(EntryType.SEND),
                              int(EntryType.CLOSE)):

@@ -1,0 +1,123 @@
+"""Stalled-follower pruning unblock — the ``force_log_pruning`` analog
+(``dare_server.c:2069-2122``).
+
+Normal pruning floors the head at the minimum apply offset over reachable
+members, so a REACHABLE follower whose apply is frozen (a wedged app)
+would otherwise block head advance forever and wedge the leader's ring.
+Under hard ring pressure the leader force-advances its head past the
+laggard (bounded by its own applied offset); the laggard detects that its
+log was pruned past its apply cursor, stops replaying (recycled slots
+must never reach the app), and is flagged for snapshot recovery — exactly
+the reference's straggler-eviction-then-rejoin semantics."""
+
+import numpy as np
+import pytest
+
+from rdma_paxos_tpu.config import LogConfig
+from rdma_paxos_tpu.consensus.snapshot import install_snapshot, take_snapshot
+from rdma_paxos_tpu.runtime.sim import SimCluster
+
+CFG = LogConfig(n_slots=64, slot_bytes=32, window_slots=16, batch_slots=8)
+
+
+def _flood(c, leader, n, tag=b"f"):
+    sent = 0
+    for i in range(n):
+        c.submit(leader, b"%s%04d" % (tag, i))
+    steps = 0
+    while c.pending[leader] and steps < 200:
+        c.step()
+        steps += 1
+    c.step()
+
+
+def test_wedged_follower_no_longer_blocks_the_ring():
+    c = SimCluster(CFG, 3)
+    c.run_until_elected(0)
+    c.step()
+
+    # wedge follower 2's apply (its app stopped consuming)
+    c.wedge_apply(2)
+    # flood well past ring capacity (63 usable slots): without forced
+    # pruning the head would floor at replica 2's frozen apply and the
+    # leader would wedge after ~63 accepted entries
+    total = 300
+    for i in range(total):
+        c.submit(0, b"w%04d" % i)
+    for _ in range(250):
+        if not c.pending[0]:
+            break
+        c.step()
+    c.step()
+    assert not c.pending[0], (
+        f"leader wedged: {len(c.pending[0])} entries still queued "
+        f"(head {int(c.last['head'][0])}, end {int(c.last['end'][0])})")
+    # the healthy replicas replayed everything
+    for r in (0, 1):
+        stream = [p for (_, _, _, p) in c.replayed[r]]
+        assert [p for p in stream if p.startswith(b"w")] == \
+            [b"w%04d" % i for i in range(total)]
+    # the wedged app resumes: its first replay attempt detects the
+    # recycled slot (stamped gidx mismatch), flags recovery, and does
+    # NOT pollute the stream with garbage
+    c.unwedge_apply(2)
+    c.step()
+    assert 2 in c.need_recovery
+    stream2 = [p for (_, _, _, p) in c.replayed[2]]
+    assert all(p == b"w%04d" % i
+               for i, p in enumerate(
+                   p for p in stream2 if p.startswith(b"w")))
+
+    # recovery: snapshot from the leader rejoins it (the reference's
+    # straggler rejoin, rc_recover_sm)
+    snap = take_snapshot(c.state, 0)
+    c.state = install_snapshot(c.state, 2, snap)
+    c.applied[2] = snap.index
+    c.need_recovery.discard(2)
+    c.submit(0, b"after-recovery")
+    c.step()
+    c.step()
+    stream2 = [p for (_, _, _, p) in c.replayed[2]]
+    assert stream2[-1] == b"after-recovery"
+
+
+def test_normal_pressure_still_respects_laggard():
+    """Below the hard-pressure threshold the old invariant holds: the
+    head never passes a reachable member's apply (P1/P2/P3 of
+    log_pruning, dare_server.c:1996-2067)."""
+    c = SimCluster(CFG, 3)
+    c.run_until_elected(0)
+    c.step()
+    c.wedge_apply(2)
+    # stay under the forced threshold (7/8 of 64 = 56): submit few
+    for i in range(20):
+        c.submit(0, b"n%02d" % i)
+        c.step()
+    c.step()
+    assert int(c.last["head"][0]) <= c.applied[2]
+    assert 2 not in c.need_recovery
+    c.unwedge_apply(2)
+
+
+def test_forced_pruning_bounded_by_leader_apply():
+    """Forced pruning never advances the head past the leader's OWN
+    applied offset — entries must be applied (and persisted) somewhere
+    before their slots recycle, or snapshot recovery would have no
+    source."""
+    c = SimCluster(CFG, 3)
+    c.run_until_elected(0)
+    c.step()
+    c.wedge_apply(1)
+    c.wedge_apply(2)
+    for i in range(300):
+        c.submit(0, b"b%04d" % i)
+    for _ in range(250):
+        if not c.pending[0]:
+            break
+        c.step()
+    c.step()
+    assert int(c.last["head"][0]) <= c.applied[0]
+    # leader alone cannot commit without a quorum? it CAN: acks come
+    # from absorb, which is independent of apply — both followers still
+    # ack, so commits flow and the leader's own apply advances
+    assert not c.pending[0]

@@ -63,3 +63,73 @@ def test_kvs_survives_failover():
     c.step()
     assert kv.get(1, b"persist", linearizable=True) == b"2"
     assert kv.get(2, b"persist") == b"2"
+
+
+def test_client_dedup_retransmit_applies_once():
+    """The dare_ep_db last_req_id analog: a client that retransmits after
+    seeing no ack must have its op applied exactly once — even when both
+    the original AND the duplicate committed (dare_ep_db.h:20-30)."""
+    c = SimCluster(CFG, 3)
+    kv = ReplicatedKVS(c, cap=256)
+    c.run_until_elected(0)
+    sess = kv.session(client_id=7)
+    rid = sess.put(0, b"k", b"v1")
+    c.step()
+    c.step()
+    # the ack was lost: client retransmits the same request (twice!)
+    sess.retransmit_put(0, b"k", b"v1", rid)
+    sess.retransmit_put(0, b"k", b"v1", rid)
+    c.step()
+    c.step()
+    assert kv.get(0, b"k", linearizable=True) == b"v1"
+    assert kv.deduped[0] == 2
+    # every replica deduped identically (fold is deterministic)
+    assert kv.get(1, b"k") == b"v1" and kv.get(2, b"k") == b"v1"
+    assert kv.deduped[1] == 2 and kv.deduped[2] == 2
+
+
+def test_client_dedup_late_duplicate_cannot_regress():
+    """A stale duplicate arriving AFTER a newer op from the same client
+    must not roll the value back (first-commit-wins ordering)."""
+    c = SimCluster(CFG, 3)
+    kv = ReplicatedKVS(c, cap=256)
+    c.run_until_elected(0)
+    sess = kv.session(client_id=9)
+    r1 = sess.put(0, b"x", b"old")
+    c.step()
+    sess.put(0, b"x", b"new")
+    c.step()
+    # duplicate of the FIRST request shows up late (e.g. a queued
+    # retransmit raced the second request)
+    sess.retransmit_put(0, b"x", b"old", r1)
+    c.step()
+    c.step()
+    assert kv.get(0, b"x", linearizable=True) == b"new"
+    assert kv.deduped[0] == 1
+
+
+def test_client_dedup_survives_failover():
+    """Retransmit against the NEW leader after the old one died: the
+    committed original is not re-applied (dedup derives from the
+    replicated log, not leader-local memory)."""
+    c = SimCluster(CFG, 3)
+    kv = ReplicatedKVS(c, cap=256)
+    c.run_until_elected(0)
+    sess = kv.session(client_id=3)
+    rid = sess.put(0, b"f", b"committed")
+    c.step()                          # committed by leader 0
+    c.step()
+    c.partition([[0], [1, 2]])        # leader dies before acking client
+    c.step(timeouts=[1])
+    # client retries against the new leader; also writes something new
+    sess.retransmit_put(1, b"f", b"committed", rid)
+    sess.put(1, b"g", b"after")
+    c.step()
+    c.step()
+    c.heal()
+    c.step()
+    c.step()
+    for r in range(3):
+        assert kv.get(r, b"f") == b"committed"
+        assert kv.get(r, b"g") == b"after"
+    assert kv.deduped[1] == 1         # new leader's fold skipped the dup
