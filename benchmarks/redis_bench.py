@@ -29,16 +29,25 @@ BUILD_ROOT = "/tmp/rp_redis_build"
 SRC = os.path.join(BUILD_ROOT, "redis-2.8.17", "src")
 
 
-def ensure_redis():
-    if os.path.exists(os.path.join(SRC, "redis-server")):
-        return
+def ensure_redis() -> str:
+    """Build pristine Redis once from the reference tree's vendored
+    upstream tarball; returns the redis-server path. Raises
+    FileNotFoundError (no tarball) or RuntimeError (build failure) —
+    the single build recipe shared by the bench and the e2e tests."""
+    server = os.path.join(SRC, "redis-server")
+    if os.path.exists(server):
+        return server
     if not os.path.exists(TARBALL):
-        raise SystemExit("reference redis tarball unavailable")
+        raise FileNotFoundError("reference redis tarball unavailable")
     os.makedirs(BUILD_ROOT, exist_ok=True)
     subprocess.run(["tar", "xzf", TARBALL], cwd=BUILD_ROOT, check=True)
-    subprocess.run(["make", "MALLOC=libc", "-j1"],
-                   cwd=os.path.join(BUILD_ROOT, "redis-2.8.17"),
-                   check=True)
+    r = subprocess.run(["make", "MALLOC=libc", "-j1"],
+                       cwd=os.path.join(BUILD_ROOT, "redis-2.8.17"),
+                       capture_output=True, timeout=900)
+    if r.returncode != 0 or not os.path.exists(server):
+        raise RuntimeError("redis build failed: %s"
+                           % r.stderr.decode()[-300:])
+    return server
 
 
 def resp(port, line):

@@ -112,7 +112,16 @@ class ClientSession:
     every replica's fold skips any request at-or-below the client's
     applied high-water mark — so a duplicate appended by ANY leader (the
     one that crashed after committing, or the new one the client retried
-    against) applies exactly once, in first-commit order."""
+    against) applies exactly once, in first-commit order.
+
+    PROTOCOL CONTRACT (same as the reference's single ``last_req_id``
+    slot per endpoint, ``dare_ep_db.h:20-30``, and Raft client
+    sessions): a session keeps AT MOST ONE request outstanding — issue
+    ``put``, and if no ack arrives, ``retransmit_put`` the SAME req_id
+    until it commits, before issuing the next req_id. A client that
+    pipelines req N+1 before req N's fate is known can lose req N: if N
+    was truncated uncommitted and N+1 commits first, the high-water mark
+    passes N and every later retransmit of N is dropped as a duplicate."""
 
     def __init__(self, kvs: ReplicatedKVS, client_id: int):
         if client_id <= 0:

@@ -99,8 +99,12 @@ class ProxyServer:
         self.node_id = node_id
         self.on_event = on_event
         # namespaced start (elastic generations) so a restarted host's
-        # fresh connection ids cannot collide with ids its previous
-        # incarnation stamped into carried-over log entries
+        # fresh connection ids avoid ids its previous incarnation stamped
+        # into carried-over log entries. The namespace is bounded (16
+        # generations x 2^20 connections before wrap), so collisions are
+        # rare, not impossible — the ReplayEngine treats a repeated
+        # CONNECT for a known id as a stream RESET, which keeps a wrap
+        # benign (M_GEN protects the ack path independently).
         self._conn_ctr = conn_ctr_start & 0xFFFFFF
         self.conn_of_fd: Dict[Tuple[int, int], int] = {}  # (link, fd) -> id
         if os.path.exists(sock_path):
@@ -199,6 +203,20 @@ class ProxyServer:
             os.unlink(self.sock_path)
 
 
+def replay_store_into(store, replay: "ReplayEngine") -> None:
+    """Rebuild a FRESH app instance by replaying the stable store's full
+    event history into it (``proxy_apply_db_snapshot`` analog,
+    ``proxy.c:306-339``) — the single decoder of the store record layout
+    (1-byte etype + 4-byte little-endian conn id + payload), shared by
+    the joiner-recovery and generation-bootstrap paths."""
+    if replay is None:
+        return
+    for i in range(len(store)):
+        rec = store.read(i)
+        replay.apply(rec[0], int.from_bytes(rec[1:5], "little"), rec[5:])
+    replay.drain_responses()
+
+
 class ReplayEngine:
     """Replays committed remote-origin events into the local app over
     loopback TCP (the follower half of the reference proxy)."""
@@ -212,6 +230,16 @@ class ReplayEngine:
         self.local_ports: set = set()
 
     def _connect(self, conn_id: int) -> socket.socket:
+        # a CONNECT for an id we already track means the id wrapped
+        # around (bounded namespaces); the new stream replaces the old
+        # one — reset rather than interleave bytes into a stale socket
+        old = self.conns.pop(conn_id, None)
+        if old is not None:
+            try:
+                self.local_ports.discard(old.getsockname()[1])
+                old.close()
+            except OSError:
+                pass
         s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         s.connect(self.addr)
         self.conns[conn_id] = s

@@ -268,6 +268,19 @@ class ClusterDriver:
 
         self._failure_detector(res)
         self._drive_config_change()
+        # a replica force-pruned past its apply cursor (wedged app now
+        # unwedged, or long stall) stopped replaying; heal it with a
+        # donor snapshot — the reference's straggler-eviction-then-
+        # rejoin collapsed into one step (one per iteration)
+        if self.cluster.need_recovery and self._leader_view >= 0:
+            # never pick the leader itself (a flagged replica can still
+            # win elections — it acks windows regardless of apply); it
+            # recovers once deposed, and must not starve the others
+            cands = self.cluster.need_recovery - {self._leader_view}
+            if cands:
+                r = min(cands)
+                self._do_recover(r, None)
+                self.cluster.need_recovery.discard(r)
         return res
 
     # ------------------------------------------------------------------
@@ -391,13 +404,9 @@ class ClusterDriver:
         if rrt.store is not None and snap.store_blob:
             rrt.store.reset()
             rrt.store.load(snap.store_blob)
-            if rrt.replay is not None:
-                # rebuild the fresh app by replaying the history blob
-                for i in range(len(rrt.store)):
-                    rec = rrt.store.read(i)
-                    etype, conn = rec[0], int.from_bytes(rec[1:5], "little")
-                    rrt.replay.apply(etype, conn, rec[5:])
-                rrt.replay.drain_responses()
+            # rebuild the fresh app by replaying the history blob
+            from rdma_paxos_tpu.proxy.proxy import replay_store_into
+            replay_store_into(rrt.store, rrt.replay)
 
     def _apply_new_entries(self, r: int, rt: _ReplicaRuntime) -> None:
         stream = self.cluster.replayed[r]

@@ -81,13 +81,15 @@ def _send_msg(sock: socket.socket, obj: dict,
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    buf = b""
-    while len(buf) < n:
-        chunk = sock.recv(min(n - len(buf), 1 << 20))
-        if not chunk:
+    buf = bytearray(n)                # linear even for large snapshots
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], min(n - got, 1 << 20))
+        if r == 0:
             raise ConnectionError("peer closed")
-        buf += chunk
-    return buf
+        got += r
+    return bytes(buf)
 
 
 def _recv_msg(sock: socket.socket) -> Tuple[dict, List[bytes]]:
@@ -240,6 +242,10 @@ class GroupController:
             if not m:
                 continue
             term_base = max(term_base, int(m.get("term", 0)))
+            if not m.get("usable", 1):
+                # a force-pruned laggard's log no longer holds its own
+                # apply cursor: installing it would wedge the generation
+                continue
             key = (int(m.get("last_log_term", 0)), int(m.get("end", 0)))
             if key > donor_key:
                 donor, donor_key = h, key

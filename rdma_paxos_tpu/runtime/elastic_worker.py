@@ -120,6 +120,18 @@ def main() -> None:
                 stash_meta = node.meta(stash_row)
                 stash_meta.update(gen=gen, round=rnd,
                                   host=args.host_id)
+                if node.needs_recovery:
+                    # force-pruned past our apply cursor: this world can
+                    # no longer serve through us — trigger a rebuild in
+                    # which the donor's store restores our app. The
+                    # detecting iteration touched neither store nor app,
+                    # so the stash pair is consistent; dump it now
+                    # (meta carries usable=0, so we cannot be donor).
+                    write_dump(args.workdir, args.host_id, stash_row,
+                               node.store.dump(), stash_meta)
+                    raise RuntimeError(
+                        "force-pruned past apply cursor; requesting "
+                        "world rebuild for snapshot recovery")
             write_dump(args.workdir, args.host_id, stash_row,
                        node.store.dump(), stash_meta)
             try:

@@ -243,17 +243,11 @@ class NodeDaemon:
 
     def bootstrap_from_store(self) -> None:
         """Rebuild a FRESH local app instance by replaying the stable
-        store's full event history into it (the joiner's
-        ``proxy_apply_db_snapshot`` analog, ``proxy.c:306-339``). Call
-        once at generation start, before the first ``iterate`` — the
-        supervisor restarts the app, this fills it."""
-        if self.replay is None:
-            return
-        for i in range(len(self.store)):
-            rec = self.store.read(i)
-            etype, conn = rec[0], int.from_bytes(rec[1:5], "little")
-            self.replay.apply(etype, conn, rec[5:])
-        self.replay.drain_responses()
+        store's full event history into it. Call once at generation
+        start, before the first ``iterate`` — the supervisor restarts
+        the app, this fills it."""
+        from rdma_paxos_tpu.proxy.proxy import replay_store_into
+        replay_store_into(self.store, self.replay)
 
     def dump_row(self) -> dict:
         """THIS replica's full consensus state row (host numpy) — what
@@ -275,10 +269,17 @@ class NodeDaemon:
             slot = (end - 1) & (self.cfg.n_slots - 1)
             lterm = int(row["log_buf"][slot,
                                        self.cfg.slot_words + M_TERM])
+        # donor eligibility: a usable recovery point must PHYSICALLY
+        # hold every entry from its host apply cursor onward (a
+        # force-pruned laggard does not — installing its row would wedge
+        # the whole new generation at the first M_GIDX check)
+        usable = int(not self.needs_recovery
+                     and self.applied >= int(row["head"])
+                     and self.applied >= end - self.cfg.n_slots)
         return dict(term=int(row["term"]), last_log_term=lterm,
                     end=end, commit=int(row["commit"]),
                     apply=int(row["apply"]), applied=self.applied,
-                    leader=int(self._is_leader))
+                    leader=int(self._is_leader), usable=usable)
 
     def run_iterations(self, n: int, period: float = 0.0,
                        watchdog_secs: float = 60.0) -> None:

@@ -21,9 +21,6 @@ from rdma_paxos_tpu.runtime.driver import ClusterDriver
 
 NATIVE = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "native")
-TARBALL = "/root/reference/apps/redis/redis-2.8.17.tar.gz"
-BUILD_ROOT = "/tmp/rp_redis_build"
-SERVER = os.path.join(BUILD_ROOT, "redis-2.8.17", "src", "redis-server")
 
 CFG = LogConfig(n_slots=512, slot_bytes=256, window_slots=64,
                 batch_slots=32)
@@ -33,22 +30,16 @@ PORTS = [_BASE, _BASE + 200, _BASE + 400]
 
 @pytest.fixture(scope="module")
 def redis_server():
-    if not os.path.exists(SERVER):
-        if not os.path.exists(TARBALL):
-            pytest.skip("reference redis tarball unavailable")
-        os.makedirs(BUILD_ROOT, exist_ok=True)
-        subprocess.run(["tar", "xzf", TARBALL], cwd=BUILD_ROOT,
-                       check=True)
-        r = subprocess.run(
-            ["make", "MALLOC=libc", "-j1"],
-            cwd=os.path.join(BUILD_ROOT, "redis-2.8.17"),
-            capture_output=True, timeout=900)
-        if r.returncode != 0 or not os.path.exists(SERVER):
-            pytest.skip("redis build failed: %s"
-                        % r.stderr.decode()[-300:])
+    # single build recipe shared with benchmarks/redis_bench.py
+    from benchmarks.redis_bench import ensure_redis
+    try:
+        server = ensure_redis()
+    except (FileNotFoundError, RuntimeError,
+            subprocess.SubprocessError) as e:
+        pytest.skip(str(e))
     subprocess.run(["make", "-C", NATIVE], check=True,
                    capture_output=True)
-    return SERVER
+    return server
 
 
 class Resp:
@@ -183,3 +174,51 @@ def test_real_redis_incr_is_not_double_applied(stack):
     c.close()
     fol = next(r for r in range(3) if r != lead)
     assert wait_get(PORTS[fol], b"ctr", b"7") == b"7"
+
+
+def test_real_redis_leader_failover(stack):
+    """The reconf_bench.sh RemoveLeader scenario on the real app: the
+    leader is partitioned away mid-service, a follower takes over,
+    clients continue against the new leader, and on heal the deposed
+    leader's Redis catches up to the exact same state (its uncommitted
+    reads were severed, never applied)."""
+    lead = stack.leader()
+    c = Resp(PORTS[lead])
+    assert c.cmd(b"SET before failover") == b"+OK"
+    c.close()
+    for r in range(3):
+        if r != lead:
+            assert wait_get(PORTS[r], b"before", b"failover") == \
+                b"failover"
+
+    # partition the leader's replica (the kill -9 analog: its app is
+    # still up but its consensus half cannot reach a quorum)
+    others = [r for r in range(3) if r != lead]
+    stack.cluster.partition([[lead], others])
+    deadline = time.time() + 30
+    while stack.leader() in (lead, -1):
+        assert time.time() < deadline, "no failover"
+        time.sleep(0.05)
+    lead2 = stack.leader()
+    assert lead2 != lead
+
+    # service continues against the new leader
+    c = Resp(PORTS[lead2])
+    assert c.cmd(b"SET during outage") == b"+OK"
+    c.close()
+    other = next(r for r in others if r != lead2)
+    assert wait_get(PORTS[other], b"during", b"outage") == b"outage"
+
+    # heal: the deposed leader's app catches up via replay
+    stack.cluster.heal()
+    assert wait_get(PORTS[lead], b"during", b"outage") == b"outage", \
+        "deposed leader's redis did not catch up after heal"
+
+    # and the whole group keeps replicating new writes
+    lead3 = stack.leader()
+    c = Resp(PORTS[lead3])
+    assert c.cmd(b"SET after heal") == b"+OK"
+    c.close()
+    for r in range(3):
+        if r != lead3:
+            assert wait_get(PORTS[r], b"after", b"heal") == b"heal"
