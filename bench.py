@@ -30,9 +30,12 @@ K = 64          # protocol steps per jit call
 # ring sized 4x the window: gather/scatter cost scales with ring rows (a
 # right-sized ring nearly doubles throughput vs a 16k-slot ring), while the
 # ring must absorb one full batch per step plus the one-step apply lag
-# without hitting the capacity clamp
-CFG = LogConfig(n_slots=4096, slot_bytes=256, window_slots=1024,
-                batch_slots=1024)
+# without hitting the capacity clamp. Geometry swept on hardware
+# (round 3): 2048-entry batches at 128-byte slots measure ~1.6x the
+# round-2 1024/256 shape back-to-back in one session; 8192-entry windows
+# exceed the Pallas kernel's scoped-VMEM tile limit.
+CFG = LogConfig(n_slots=8192, slot_bytes=128, window_slots=2048,
+                batch_slots=2048)
 BASELINE_OPS = 1_000_000.0   # BASELINE.md north-star: 1M Redis SET ops/s
 
 

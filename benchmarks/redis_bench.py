@@ -73,7 +73,10 @@ def main():
     ap.add_argument("--port-base", type=int, default=9860)
     args = ap.parse_args()
 
-    ensure_redis()
+    try:
+        ensure_redis()
+    except (FileNotFoundError, RuntimeError) as e:
+        raise SystemExit(str(e))
     os.environ.setdefault(
         "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
     import jax

@@ -49,15 +49,23 @@ class Snapshot:
 
 
 def take_snapshot(state_b: ReplicaState, donor: int,
-                  store_blob: bytes = b"") -> Snapshot:
+                  store_blob: bytes = b"",
+                  index: Optional[int] = None) -> Snapshot:
     """Capture a snapshot from replica ``donor`` of a batched state.
 
     Batched state carries the fused log as ``buf[R, n_slots, slot_words +
     META_W]``; the determinant term of entry ``apply-1`` lives at
     ``buf[donor, slot, slot_words + M_TERM]``.
-    """
+
+    ``index`` overrides the determinant index: pass the donor's HOST
+    apply counter when the accompanying ``store_blob`` was produced by
+    the host — the device-side ``apply`` can LAG the host's by one
+    step's echo, and a snapshot whose index undershoots its store would
+    make the recovered replica re-apply (and re-persist) records the
+    store already holds."""
     log = state_b.log
-    apply_ = int(np.asarray(state_b.apply[donor]))
+    apply_ = (int(np.asarray(state_b.apply[donor])) if index is None
+              else int(index))
     term = 0
     if apply_ > 0:
         slot = (apply_ - 1) & (log.n_slots - 1)

@@ -203,15 +203,19 @@ class ProxyServer:
             os.unlink(self.sock_path)
 
 
-def replay_store_into(store, replay: "ReplayEngine") -> None:
-    """Rebuild a FRESH app instance by replaying the stable store's full
-    event history into it (``proxy_apply_db_snapshot`` analog,
+def replay_store_into(store, replay: "ReplayEngine",
+                      start: int = 0) -> None:
+    """Replay the stable store's event history from record ``start``
+    into the local app (``proxy_apply_db_snapshot`` analog,
     ``proxy.c:306-339``) — the single decoder of the store record layout
-    (1-byte etype + 4-byte little-endian conn id + payload), shared by
-    the joiner-recovery and generation-bootstrap paths."""
+    (1-byte etype + 4-byte little-endian conn id + payload). ``start=0``
+    rebuilds a FRESH app; a nonzero ``start`` delivers only the delta to
+    a LIVE app that already executed the prefix (store streams are
+    prefix-consistent: every store is a prefix of the committed event
+    order)."""
     if replay is None:
         return
-    for i in range(len(store)):
+    for i in range(start, len(store)):
         rec = store.read(i)
         replay.apply(rec[0], int.from_bytes(rec[1:5], "little"), rec[5:])
     replay.drain_responses()

@@ -272,14 +272,21 @@ class ClusterDriver:
         # unwedged, or long stall) stopped replaying; heal it with a
         # donor snapshot — the reference's straggler-eviction-then-
         # rejoin collapsed into one step (one per iteration)
-        if self.cluster.need_recovery and self._leader_view >= 0:
-            # never pick the leader itself (a flagged replica can still
-            # win elections — it acks windows regardless of apply); it
-            # recovers once deposed, and must not starve the others
+        if (self.cluster.need_recovery
+                and self._leader_view >= 0
+                # the donor is the leader: it must itself be healthy —
+                # a flagged leader's host store is frozen, so its
+                # snapshot would silently drop acked writes; wait for
+                # leadership to move to a usable member instead
+                and self._leader_view not in self.cluster.need_recovery):
+            # never pick the leader itself as the recoveree either (a
+            # flagged replica can still win elections — it acks windows
+            # regardless of apply); it recovers once deposed, and must
+            # not starve the others
             cands = self.cluster.need_recovery - {self._leader_view}
             if cands:
                 r = min(cands)
-                self._do_recover(r, None)
+                self._do_recover(r, None, app_fresh=False)
                 self.cluster.need_recovery.discard(r)
         return res
 
@@ -378,13 +385,21 @@ class ClusterDriver:
         elif not done.wait(timeout):
             raise TimeoutError("recovery did not run (loop stalled?)")
 
-    def _do_recover(self, r: int, donor: Optional[int]) -> None:
+    def _do_recover(self, r: int, donor: Optional[int],
+                    app_fresh: bool = True) -> None:
+        """``app_fresh=False`` (the auto-recovery path) replays only the
+        DELTA of the donor's history into r's still-running app — the
+        app already executed its own store's prefix; a full replay would
+        double-apply non-idempotent commands."""
         donor = self._leader_view if donor is None else donor
         if donor < 0:
             raise RuntimeError("no donor available")
         drt, rrt = self.runtimes[donor], self.runtimes[r]
         blob = drt.store.dump() if drt.store else b""
-        snap = take_snapshot(self.cluster.state, donor, blob)
+        # the blob matches the donor's HOST apply counter; the device
+        # apply can lag it by one step's echo — snapshot at the host's
+        snap = take_snapshot(self.cluster.state, donor, blob,
+                             index=int(self.cluster.applied[donor]))
         # restore election durability: newest vote among live peers'
         # records (read BEFORE install wipes r's rows) and r's HardState
         # file; current term floored at all of them
@@ -402,11 +417,16 @@ class ClusterDriver:
         rt_stream = self.cluster.replayed[r]
         rrt.replay_cursor = len(rt_stream)
         if rrt.store is not None and snap.store_blob:
+            old_len = len(rrt.store)
             rrt.store.reset()
             rrt.store.load(snap.store_blob)
-            # rebuild the fresh app by replaying the history blob
             from rdma_paxos_tpu.proxy.proxy import replay_store_into
-            replay_store_into(rrt.store, rrt.replay)
+            # fresh app: rebuild with the full history; live app (auto
+            # recovery): deliver only the records beyond the prefix it
+            # already executed — its own old store (a prefix of the
+            # donor's, both being the committed order)
+            replay_store_into(rrt.store, rrt.replay,
+                              start=0 if app_fresh else old_len)
 
     def _apply_new_entries(self, r: int, rt: _ReplicaRuntime) -> None:
         stream = self.cluster.replayed[r]

@@ -237,10 +237,12 @@ class GroupController:
         self._gen += 1
         donor, donor_key = -1, (-1, -1)
         term_base = 0
+        has_meta = False
         for h in hosts:
             m = self._reg[h].get("meta")
             if not m:
                 continue
+            has_meta = True
             term_base = max(term_base, int(m.get("term", 0)))
             if not m.get("usable", 1):
                 # a force-pruned laggard's log no longer holds its own
@@ -249,6 +251,13 @@ class GroupController:
             key = (int(m.get("last_log_term", 0)), int(m.get("end", 0)))
             if key > donor_key:
                 donor, donor_key = h, key
+        if has_meta and donor < 0:
+            # the group HAS history but no member can donate it (every
+            # dump is unusable): cutting a fresh world here would
+            # silently discard committed state — refuse and wait for
+            # operator intervention or a usable registration, exactly
+            # like the majority-overlap guard above
+            return
         members = [{"host": h, "addr": self._reg[h]["addr"]}
                    for h in hosts]
         coord_host = self._reg[hosts[0]]["addr"].rsplit(":", 1)[0]

@@ -10,6 +10,8 @@ log was pruned past its apply cursor, stops replaying (recycled slots
 must never reach the app), and is flagged for snapshot recovery — exactly
 the reference's straggler-eviction-then-rejoin semantics."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -18,6 +20,8 @@ from rdma_paxos_tpu.consensus.snapshot import install_snapshot, take_snapshot
 from rdma_paxos_tpu.runtime.sim import SimCluster
 
 CFG = LogConfig(n_slots=64, slot_bytes=32, window_slots=16, batch_slots=8)
+CFG_APP = LogConfig(n_slots=64, slot_bytes=64, window_slots=16,
+                    batch_slots=8)
 
 
 def _flood(c, leader, n, tag=b"f"):
@@ -173,3 +177,78 @@ def test_driver_auto_recovers_force_pruned_replica(tmp_path):
             p for (_, _, _, p) in d.cluster.replayed[victim]]
     finally:
         d.stop()
+
+
+def test_auto_recovery_live_app_exactly_once(tmp_path):
+    """Force-pruned follower WITH a real app attached: auto-recovery
+    must deliver only the DELTA into the still-running app — a full
+    history replay would double-apply (key counts prove exactly-once)."""
+    import socket
+    import subprocess
+    import time as _t
+    from rdma_paxos_tpu.config import TimeoutConfig
+    from rdma_paxos_tpu.runtime.driver import ClusterDriver
+
+    native = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "native")
+    subprocess.run(["make", "-C", native], check=True,
+                   capture_output=True)
+    base = 9950 + (os.getpid() % 40)
+    ports = [base, base + 40, base + 80]
+    d = ClusterDriver(CFG_APP, 3, workdir=str(tmp_path), app_ports=ports,
+                      timeout_cfg=TimeoutConfig(elec_timeout_low=0.3,
+                                                elec_timeout_high=0.6))
+    apps = []
+    try:
+        for r, port in enumerate(ports):
+            env = dict(os.environ)
+            env["LD_PRELOAD"] = os.path.join(native, "interpose.so")
+            env["RP_PROXY_SOCK"] = os.path.join(str(tmp_path),
+                                                f"proxy{r}.sock")
+            apps.append(subprocess.Popen(
+                [os.path.join(native, "toyserver"), str(port)],
+                env=env, stderr=subprocess.DEVNULL))
+        _t.sleep(0.3)
+        d.run(period=0.002)
+        t0 = _t.time()
+        while d.leader() < 0 and _t.time() - t0 < 60:
+            _t.sleep(0.05)
+        lead = d.leader()
+        assert lead >= 0
+        victim = (lead + 1) % 3
+
+        def kv(port, line):
+            s = socket.create_connection(("127.0.0.1", port), timeout=10)
+            f = s.makefile("rb")
+            s.sendall(line)
+            out = f.readline().strip()
+            s.close()
+            return out
+
+        assert kv(ports[lead], b"SET pre wedge\n") == b"+OK"
+        _t.sleep(0.5)
+        d.cluster.wedge_apply(victim)
+        s = socket.create_connection(("127.0.0.1", ports[lead]),
+                                     timeout=30)
+        f = s.makefile("rb")
+        for i in range(300):        # way past the 64-slot ring
+            s.sendall(b"SET k%03d v%03d\n" % (i, i))
+            assert f.readline().strip() == b"+OK"
+        s.close()
+        d.cluster.unwedge_apply(victim)
+        deadline = _t.time() + 40
+        while (victim in d.cluster.need_recovery
+               or d.cluster.applied[victim]
+               < d.cluster.applied[lead] - 20):
+            assert _t.time() < deadline, "auto-recovery incomplete"
+            _t.sleep(0.1)
+        _t.sleep(1.0)
+        assert kv(ports[victim], b"COUNT\n") == \
+            kv(ports[lead], b"COUNT\n"), "double/missed apply"
+        assert kv(ports[victim], b"GET k250\n") == b"v250"
+        assert kv(ports[victim], b"GET pre\n") == b"wedge"
+    finally:
+        d.stop()
+        for a in apps:
+            a.kill()
+            a.wait()
