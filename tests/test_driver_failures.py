@@ -60,3 +60,35 @@ def test_driver_snapshot_recovery_path():
         r = d.step()
     assert int(r["end"][3]) == int(r["end"][0])
     d.stop()
+
+
+def test_poll_loop_crash_releases_and_rejects_events():
+    """A step exception on the poll thread must fail every blocked
+    commit waiter AND fail-fast any event arriving afterwards — app
+    threads must never hang on a dead loop (advisor finding: the old
+    loop died silently with waiters parked forever)."""
+    import time
+
+    d = make_driver()
+    d.cluster.run_until_elected(0)
+    d.step()
+    handler = d._make_handler(0)
+    conn = (0 << 24) | 1
+    handler(2, conn, b"")               # CONNECT on the leader
+    ev = handler(3, conn, b"blocked-op")
+    assert ev is not None and not isinstance(ev, int)
+
+    # poison the next cluster step, then run the loop
+    def boom(*a, **k):
+        raise RuntimeError("injected step failure")
+    d.cluster.step = boom
+    d.cluster.step_burst = boom
+    d.run()
+    assert ev.done.wait(10), "blocked event never released"
+    assert ev.status == -1
+    assert isinstance(d.loop_error, RuntimeError)
+    # post-crash events are rejected immediately, not queued
+    t0 = time.time()
+    assert handler(3, conn, b"late-op") == -1
+    assert time.time() - t0 < 1.0
+    d.stop()
