@@ -18,23 +18,56 @@ _LIB_PATH = os.path.join(_NATIVE_DIR, "libstablestore.so")
 _lib: Optional[ctypes.CDLL] = None
 
 
-def atomic_write(path: str, data: bytes) -> None:
-    """Crash-safe whole-file write: tmp + fsync + rename + parent-dir
-    fsync — a crash at any point leaves either the old complete file or
-    the new complete file, never a mix. The single implementation for
-    every durable control file (HardState, elastic recovery dumps)."""
+def atomic_write(path: str, data: bytes, durable: bool = True) -> None:
+    """Crash-safe whole-file write: tmp + rename (+ fsyncs when
+    ``durable``) — a crash at any point leaves either the old complete
+    file or the new complete file, never a mix. ``durable=False`` skips
+    the fsyncs: the rename is still atomic against PROCESS death (abort,
+    SIGKILL), just not against power loss — right for high-frequency
+    recovery points whose loss only widens the recovery window. The
+    single implementation for every control file (HardState, elastic
+    recovery dumps)."""
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         f.write(data)
-        f.flush()
-        os.fsync(f.fileno())
+        if durable:
+            f.flush()
+            os.fsync(f.fileno())
     os.replace(tmp, path)
-    dfd = os.open(os.path.dirname(os.path.abspath(path)) or ".",
-                  os.O_RDONLY)
+    if durable:
+        dfd = os.open(os.path.dirname(os.path.abspath(path)) or ".",
+                      os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+
+
+def trimmed_dump(path: str, n: int) -> bytes:
+    """Serialize the FIRST ``n`` records of the store at ``path`` — used
+    to reconstruct the store blob that pairs with a recovery point taken
+    when the (still-live, possibly longer) store had ``n`` records."""
+    import tempfile
+    src = StableStore(path)
     try:
-        os.fsync(dfd)
+        if n >= len(src):
+            return src.dump()
+        fd, tmp = tempfile.mkstemp(suffix=".trim")
+        os.close(fd)
+        os.unlink(tmp)               # ss_open creates it fresh
+        dst = StableStore(tmp)
+        try:
+            for i in range(n):
+                dst.append(src.read(i))
+            return dst.dump()
+        finally:
+            dst.close()
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
     finally:
-        os.close(dfd)
+        src.close()
 
 
 def _load() -> ctypes.CDLL:

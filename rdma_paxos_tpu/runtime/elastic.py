@@ -34,17 +34,23 @@ of **generations**:
   ``proxy_apply_db_snapshot`` analog — so a restarted host serves the
   full replicated history the moment its generation starts.
 
-Worker processes dump a consistent (state row, store blob) pair at every
-round barrier, keep an in-memory stash of the last COMPLETED iteration,
-and flush that stash to disk when a collective fails mid-round — so a
-surviving member's recovery point always includes every write it acked
-(the ack happens only after the iteration's store fsync). A worker
-hard-killed outright (SIGKILL, coordination-service abort) counts as a
-FAILED member: the guarantee that acked writes survive needs only a
-majority of SURVIVING members, whose logs carry every committed entry
-(the ack's quorum) even when their apply/store lags. The supervisor
-(this module's :class:`ElasticSupervisor`) never runs JAX itself and
-survives any worker death.
+Workers cannot rely on crash handlers: the JAX coordination-service
+client LOG(FATAL)s the whole process the instant it learns a peer died,
+racing (and often beating) the catchable collective error. So recovery
+points are written BEFORE failures, not at them: after every completed
+iteration a small (state row, meta + live-store length) pair is renamed
+into place (atomic against process death), and :func:`best_recovery`
+pairs it with the live store trimmed to the recorded length — the
+freshest recovery point, containing every write the member acked, is
+never more than one iteration old regardless of how the process dies. A
+durable fsynced full triple is additionally written at every round
+barrier (the power-loss tier). A member hard-killed outright counts as a
+FAILED member: acked-write survival needs only a majority of SURVIVING
+members, whose recovery points carry every committed entry. The
+supervisor (this module's :class:`ElasticSupervisor`) never runs JAX
+itself and survives any worker death; it freezes the recovery point it
+offers (and serves to fetches) at registration time, so every member of
+a cut installs exactly the state the donor election ranked.
 
 Wire protocol: newline-delimited JSON over short-lived TCP connections;
 binary blobs ride length-prefixed after the JSON header.
@@ -163,6 +169,82 @@ def read_dump(workdir: str, host_id: int
     except (OSError, json.JSONDecodeError, ValueError, struct.error):
         return None
     return row, store, meta
+
+
+# --- per-iteration recovery points (row + meta only) -----------------------
+#
+# Workers can be killed INSTANTLY and un-catchably — the JAX
+# coordination-service client LOG(FATAL)s the whole process the moment it
+# learns a peer died, racing (and often beating) the catchable collective
+# error. No crash handler can be relied on, so after EVERY iteration the
+# worker persists a small (state row, meta) pair by atomic rename
+# (durable=False: safe against process death, which is the threat here).
+# The meta records the live store's record count at that moment; recovery
+# reconstructs the matching store blob by trimming the live store file —
+# so the freshest recovery point is never more than one iteration old,
+# abort or no abort.
+
+def rowdump_path(workdir: str, host_id: int) -> str:
+    return os.path.join(workdir, f"rowdump_h{host_id}.bin")
+
+
+def write_rowdump(workdir: str, host_id: int, row: dict,
+                  meta: dict) -> None:
+    from rdma_paxos_tpu.proxy.stablestore import atomic_write
+    row_npz = _row_to_npz(row)
+    head = json.dumps(meta).encode()
+    atomic_write(rowdump_path(workdir, host_id),
+                 struct.pack("<I", len(head)) + head
+                 + struct.pack("<Q", len(row_npz)) + row_npz,
+                 durable=False)
+
+
+def read_rowdump(workdir: str, host_id: int
+                 ) -> Optional[Tuple[dict, dict]]:
+    try:
+        with open(rowdump_path(workdir, host_id), "rb") as f:
+            (hlen,) = struct.unpack("<I", f.read(4))
+            meta = json.loads(f.read(hlen))
+            (rlen,) = struct.unpack("<Q", f.read(8))
+            blob = f.read(rlen)
+            if len(blob) != rlen:
+                return None
+            row = _npz_to_row(blob)
+    except (OSError, json.JSONDecodeError, ValueError, struct.error):
+        return None
+    return row, meta
+
+
+def best_recovery(workdir: str, host_id: int
+                  ) -> Optional[Tuple[dict, bytes, dict]]:
+    """The freshest consistent (row, store blob, meta) recovery point:
+    the per-iteration rowdump (paired with the live store trimmed to its
+    recorded length) when it is newer than the last barrier dump, else
+    the barrier dump."""
+    from rdma_paxos_tpu.proxy.stablestore import trimmed_dump
+
+    def freshness(m: dict):
+        # generations strictly order recovery points: a later world's
+        # genesis can legitimately START with a lower applied offset
+        # than an earlier world reached, and regressing across worlds
+        # would lose the later world's acked writes
+        return (int(m.get("gen", 0)), int(m.get("applied", -1)))
+
+    barrier = read_dump(workdir, host_id)
+    rd = read_rowdump(workdir, host_id)
+    if rd is not None:
+        row, meta = rd
+        if barrier is None or freshness(meta) >= freshness(barrier[2]):
+            store_path = os.path.join(workdir, f"host{host_id}.db")
+            n = int(meta.get("store_len", 0))
+            try:
+                blob = (trimmed_dump(store_path, n)
+                        if os.path.exists(store_path) else b"")
+            except OSError:
+                blob = None
+            if blob is not None:
+                return row, blob, meta
+    return barrier
 
 
 # ---------------------------------------------------------------------------
@@ -411,6 +493,10 @@ class ElasticSupervisor:
         self._last_gen = 0
         self._child: Optional[subprocess.Popen] = None
         self._app: Optional[subprocess.Popen] = None
+        # the recovery point offered for the NEXT generation, frozen at
+        # registration time (no worker is running then, so the store
+        # file is quiescent); donor fetches serve exactly this
+        self._offered: Optional[Tuple[dict, bytes, dict]] = None
         threading.Thread(target=self._serve, daemon=True).start()
 
     # ---------------- dump serving (the donor side) ----------------
@@ -429,7 +515,11 @@ class ElasticSupervisor:
             conn.settimeout(60)
             req, _ = _recv_msg(conn)
             if req.get("op") == "fetch":
-                d = read_dump(self.workdir, self.host_id)
+                # serve the FROZEN offer captured at registration: the
+                # live store may be getting replaced by our own _prepare
+                # concurrently, and every member of the cut must see the
+                # same donor state the controller elected on
+                d = self._offered
                 if d is None:
                     _send_msg(conn, {"ok": 0})
                 else:
@@ -448,10 +538,6 @@ class ElasticSupervisor:
 
     # ---------------- generation lifecycle ----------------
 
-    def _my_meta(self) -> Optional[dict]:
-        d = read_dump(self.workdir, self.host_id)
-        return d[2] if d is not None else None
-
     def _prepare(self, spec: dict) -> None:
         """Install the donor's state + store for the coming generation
         (uniformly for every member — see module docstring)."""
@@ -460,8 +546,8 @@ class ElasticSupervisor:
         if donor < 0:
             return
         if donor == self.host_id:
-            d = read_dump(self.workdir, self.host_id)
-            assert d is not None, "donor lost its own dump"
+            d = self._offered
+            assert d is not None, "donor lost its own recovery point"
             row_npz, store_blob, donor_meta = (_row_to_npz(d[0]), d[1],
                                                d[2])
         else:
@@ -476,6 +562,15 @@ class ElasticSupervisor:
             f.write(row_npz)
         with open(f"{base}_meta_h{self.host_id}.json", "w") as f:
             json.dump(donor_meta, f)
+        # the old per-iteration rowdump pairs with the OLD store
+        # contents: remove it BEFORE the store is replaced (a supervisor
+        # killed in between then merely falls back to its consistent
+        # barrier dump, instead of mis-pairing the old row with the new
+        # store); our _offered copy keeps the old point safe in memory
+        try:
+            os.unlink(rowdump_path(self.workdir, self.host_id))
+        except OSError:
+            pass
         store = StableStore(os.path.join(self.workdir,
                                          f"host{self.host_id}.db"))
         try:
@@ -538,6 +633,13 @@ class ElasticSupervisor:
                 aenv["RP_PROXY_SOCK"] = sock_path
                 self._app = subprocess.Popen(
                     cmd, env=aenv, stderr=subprocess.DEVNULL)
+                print(f"supervisor h{self.host_id}: app started on "
+                      f"port {self.app_port} (gen {spec['gen']}, pid "
+                      f"{self._app.pid})", flush=True)
+            else:
+                print(f"supervisor h{self.host_id}: worker sock never "
+                      f"appeared (gen {spec['gen']}) — app NOT started",
+                      flush=True)
 
     def _reap(self) -> None:
         if self._app is not None:
@@ -550,10 +652,15 @@ class ElasticSupervisor:
         """Supervisor main loop: register → wait for a generation that
         includes this host → prepare → run the worker → repeat."""
         while not self._stop.is_set():
+            # freeze the recovery point we offer this cycle (no worker
+            # is running, so the store file is quiescent right now)
+            self._offered = best_recovery(self.workdir, self.host_id)
             try:
                 call(self.controller,
                      {"op": "register", "host": self.host_id,
-                      "addr": self.addr, "meta": self._my_meta()})
+                      "addr": self.addr,
+                      "meta": (self._offered[2]
+                               if self._offered else None)})
             except (OSError, ConnectionError):
                 time.sleep(0.5)
                 continue

@@ -54,7 +54,8 @@ def main() -> None:
 
     from rdma_paxos_tpu.config import LogConfig, TimeoutConfig
     from rdma_paxos_tpu.consensus.snapshot import genesis_row
-    from rdma_paxos_tpu.runtime.elastic import call, write_dump
+    from rdma_paxos_tpu.runtime.elastic import (call, write_dump,
+                                                write_rowdump)
     from rdma_paxos_tpu.runtime.node import NodeDaemon
 
     if args.cfg_json:
@@ -92,48 +93,70 @@ def main() -> None:
 
     if args.app_port:
         # the supervisor starts the app once our proxy socket exists;
-        # wait until it accepts before replaying history into it
-        deadline = time.monotonic() + 60
-        while time.monotonic() < deadline:
+        # wait until it accepts before replaying history into it. A
+        # missing app is FATAL, not skippable: booting consensus with an
+        # app that missed its history bootstrap serves wrong data.
+        deadline = time.monotonic() + 120
+        while True:
             try:
                 socket.create_connection(("127.0.0.1", args.app_port),
                                          timeout=2).close()
                 break
             except OSError:
+                if time.monotonic() >= deadline:
+                    print(f"FATAL: app on port {args.app_port} never "
+                          "came up; aborting generation", flush=True)
+                    os._exit(1)
                 time.sleep(0.1)
     node.bootstrap_from_store()
+    print(f"gen {spec['gen']}: bootstrapped app from "
+          f"{len(node.store)} store records (applied={node.applied})",
+          flush=True)
 
     gen, rnd = int(spec["gen"]), 0
-    # Per-iteration stash: after every COMPLETED iteration, keep the
-    # state row + meta in memory. On a mid-round collective failure (a
-    # peer died), the live store sits exactly at the stashed iteration
-    # (the failing step never reached its apply phase), so the pair is a
-    # CONSISTENT recovery point that includes every write acked so far —
-    # this is what makes "acked writes survive any tolerated failure"
-    # true even for failures between round barriers.
-    stash_row = stash_meta = None
+    # Per-iteration RECOVERY POINT on disk: a worker can be killed
+    # instantly and un-catchably — the JAX coordination-service client
+    # LOG(FATAL)s the process the moment it learns a peer died, often
+    # beating the catchable collective error — so no crash handler can
+    # be relied on. After every completed iteration the (row, meta +
+    # live-store length) pair is renamed into place (atomic vs process
+    # death); recovery pairs it with the live store trimmed to that
+    # length (elastic.best_recovery), so the freshest recovery point —
+    # containing every write acked so far — is never more than one
+    # iteration old, however the process dies.
+    last_progress = None
     try:
         while True:
+            row = meta = None
             for _ in range(args.round_iters):
-                node.iterate()
-                stash_row = node.dump_row()
-                stash_meta = node.meta(stash_row)
-                stash_meta.update(gen=gen, round=rnd,
-                                  host=args.host_id)
+                res = node.iterate()
+                # recovery points only need refreshing when the state
+                # advanced — an ack implies progress in that iteration,
+                # so acked writes are always covered; idle iterations
+                # skip the row serialization + write entirely
+                progress = (node.applied, int(res["term"]),
+                            int(res["end"]), int(res["commit"]))
+                if row is None or progress != last_progress:
+                    last_progress = progress
+                    row = node.dump_row()
+                    meta = node.meta(row)
+                    meta.update(gen=gen, round=rnd, host=args.host_id,
+                                store_len=len(node.store))
+                    write_rowdump(args.workdir, args.host_id, row, meta)
                 if node.needs_recovery:
-                    # force-pruned past our apply cursor: this world can
-                    # no longer serve through us — trigger a rebuild in
-                    # which the donor's store restores our app. The
-                    # detecting iteration touched neither store nor app,
-                    # so the stash pair is consistent; dump it now
-                    # (meta carries usable=0, so we cannot be donor).
-                    write_dump(args.workdir, args.host_id, stash_row,
-                               node.store.dump(), stash_meta)
+                    # force-pruned past our apply cursor: this world
+                    # can no longer serve through us — trigger a
+                    # rebuild in which the donor's store restores our
+                    # app (our meta carries usable=0: never the donor)
                     raise RuntimeError(
                         "force-pruned past apply cursor; requesting "
                         "world rebuild for snapshot recovery")
-            write_dump(args.workdir, args.host_id, stash_row,
-                       node.store.dump(), stash_meta)
+            # round barrier + a DURABLE full dump (fsynced triple —
+            # the power-loss-safe recovery tier); a fully idle round
+            # leaves the previous dump standing
+            if row is not None:
+                write_dump(args.workdir, args.host_id, row,
+                           node.store.dump(), meta)
             try:
                 resp, _ = call(
                     args.controller,
@@ -149,17 +172,9 @@ def main() -> None:
     except Exception:
         import traceback
         traceback.print_exc()
-        # dump the stash UNLESS the failure hit the apply phase (then
-        # the live store may be mid-iteration, ahead of the stashed row
-        # — fall back to the last barrier dump already on disk)
-        if stash_row is not None and node.phase == "step":
-            try:
-                write_dump(args.workdir, args.host_id, stash_row,
-                           node.store.dump(), stash_meta)
-            except Exception:
-                traceback.print_exc()
-        # exit hard so the wedged distributed runtime cannot block us
-        # (its shutdown barrier would abort anyway once a peer is gone)
+        # the per-iteration rowdump on disk is the recovery point; exit
+        # hard so the wedged distributed runtime cannot block us (its
+        # shutdown barrier would abort anyway once a peer is gone)
         sys.stdout.flush()
         os._exit(1)
     node.close()

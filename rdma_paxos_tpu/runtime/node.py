@@ -79,7 +79,6 @@ class NodeDaemon:
                               # cross-incarnation req compares never happen
         self.applied = int(genesis["apply"]) if genesis is not None else 0
         self.needs_recovery = False   # force-pruned past our apply cursor
-        self.phase = "idle"           # "step" | "apply" (crash forensics)
         self.replicated_conns: set = set()
         self.passthrough_conns: set = set()
         self.sock_path = os.path.join(workdir, f"proxy{self.me}.sock")
@@ -159,15 +158,8 @@ class NodeDaemon:
             fire = True
             self.timer.beat()
 
-        # phase marker for crash-dump consistency: an exception in the
-        # "step" phase leaves the store exactly at the previous
-        # iteration's state (safe to pair with a stashed row); an
-        # exception mid-"apply" does not (the caller falls back to its
-        # last barrier dump)
-        self.phase = "step"
         res = self.hd.step(batch=batch, timeout_fired=fire,
                            apply_done=self.applied, gen=self.gen)
-        self.phase = "apply"
         self.hard.save(int(res["term"]), int(res["voted_term"]),
                        int(res["voted_for"]))
         was_leader = self._is_leader

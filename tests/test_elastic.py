@@ -116,8 +116,12 @@ def test_genesis_boot_in_sim():
 # full multi-process scenario
 # ---------------------------------------------------------------------------
 
-_BASE = 8600 + (os.getpid() % 300)
-APP_PORTS = {0: _BASE, 1: _BASE + 300, 2: _BASE + 600}
+# all offsets share one residue class mod 300 so two pytest processes
+# (different pids) can never collide on each other's host ports; the
+# 17000+ base clears every other test file's range
+_BASE = 17000 + (os.getpid() % 300)
+APP_PORTS = {0: _BASE, 1: _BASE + 300, 2: _BASE + 600,
+             3: _BASE + 900}
 
 CFG_JSON = json.dumps({
     "log": {"n_slots": 256, "slot_bytes": 64, "window_slots": 32,
@@ -150,9 +154,9 @@ def _wait_kv(port, key, want, timeout=60.0):
 
 
 def _dump_meta(workdir, h):
-    from rdma_paxos_tpu.runtime.elastic import read_dump
-    d = read_dump(workdir, h)
-    return d[2] if d is not None else None
+    from rdma_paxos_tpu.runtime.elastic import read_rowdump
+    d = read_rowdump(workdir, h)
+    return d[1] if d is not None else None
 
 
 def _wait_leader(dirs, hosts, gen, timeout=150.0):
@@ -213,6 +217,17 @@ def _wait_gen(ctl, g, timeout=120.0):
     raise AssertionError(f"generation {g} never cut")
 
 
+def _wait_member(ctl, host, after_gen, timeout=150.0):
+    """Wait (across generation churn) for a generation that includes
+    ``host``; returns its spec."""
+    spec = _wait_gen(ctl, after_gen + 1)
+    deadline = time.time() + timeout
+    while host not in [m["host"] for m in spec["members"]]:
+        assert time.time() < deadline, f"host {host} never admitted"
+        spec = _wait_gen(ctl, spec["gen"] + 1)
+    return spec
+
+
 @pytest.fixture(scope="module")
 def built_native():
     subprocess.run(["make", "-C", NATIVE], check=True,
@@ -261,11 +276,7 @@ def test_elastic_loss_restart_rejoin(tmp_path, built_native):
 
         # ---- restart the victim: it must rejoin via snapshot ----
         sups[victim] = mk_sup(victim)
-        spec3 = _wait_gen(ctl, spec2["gen"] + 1)
-        deadline = time.time() + 150
-        while victim not in [m["host"] for m in spec3["members"]]:
-            assert time.time() < deadline, "victim never readmitted"
-            spec3 = _wait_gen(ctl, spec3["gen"] + 1)
+        spec3 = _wait_member(ctl, victim, spec2["gen"])
         gen3 = spec3["gen"]
 
         # the rejoined host serves the FULL history: the gen-1 write it
@@ -279,6 +290,21 @@ def test_elastic_loss_restart_rejoin(tmp_path, built_native):
         members3 = [m["host"] for m in spec3["members"]]
         _wait_leader(dirs, members3, gen3)
         _replicated_set(dirs, members3, b"back", b"three")
+
+        # ---- a BRAND-NEW host joins the running group (the reference's
+        # AddServer: a server never seen before is admitted and
+        # snapshot-recovers the full history, reconf_bench.sh:153) ----
+        dirs[3] = str(tmp_path / "h3")
+        sups[3] = mk_sup(3)
+        spec4 = _wait_member(ctl, 3, gen3)
+        # the joiner serves history it never witnessed...
+        assert _wait_kv(APP_PORTS[3], b"era", b"first",
+                        timeout=150) == b"first"
+        assert _wait_kv(APP_PORTS[3], b"back", b"three") == b"three"
+        # ...and participates in new replication
+        members4 = [m["host"] for m in spec4["members"]]
+        _wait_leader(dirs, members4, spec4["gen"])
+        _replicated_set(dirs, members4, b"four", b"hosts")
     finally:
         for sup in sups.values():
             sup.stop()
