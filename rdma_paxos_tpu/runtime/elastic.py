@@ -377,6 +377,8 @@ class GroupController:
                         and h not in [m["host"]
                                       for m in self._spec["members"]]):
                     # a newcomer wants in: break the running generation
+                    print(f"controller: gen {self._gen} break — "
+                          f"newcomer h{h} registered", flush=True)
                     self._regen_wanted = True
                     self._lock.notify_all()
                 self._maybe_cut()
@@ -391,6 +393,8 @@ class GroupController:
                 return {"ok": 0, "gen": self._gen, "pending": True}
             if op in ("fail", "leave"):
                 h = int(req["host"])
+                print(f"controller: gen {self._gen} break — "
+                      f"{op} from h{h}", flush=True)
                 self._regen_wanted = True
                 if op == "leave":
                     self._reg.pop(h, None)
@@ -421,6 +425,10 @@ class GroupController:
                 left = deadline - time.monotonic()
                 if left <= 0:
                     # a member never arrived: the generation is broken
+                    missing = members - self._barriers.get(key, set())
+                    print(f"controller: gen {g} break — barrier "
+                          f"round {r} timed out waiting for "
+                          f"{sorted(missing)}", flush=True)
                     self._regen_wanted = True
                     self._lock.notify_all()
                     return {"ok": 0, "gen": self._gen}
