@@ -296,6 +296,17 @@ class GroupController:
 
     # ------------------------------------------------------------------
 
+    def _break(self, reason: str) -> None:
+        """Break the RUNNING generation (workers exit at their next
+        round barrier) and log why. No-op logging-wise when no
+        generation is active — there is nothing to break, only pending
+        registrations to re-evaluate. Caller holds the lock."""
+        if self._spec is not None:
+            print(f"controller: gen {self._gen} break — {reason}",
+                  flush=True)
+        self._regen_wanted = True
+        self._lock.notify_all()
+
     def _maybe_cut(self) -> None:
         """Cut a new generation if the pending set is stable + quorate.
         Caller holds the lock."""
@@ -376,11 +387,8 @@ class GroupController:
                 if (self._spec is not None
                         and h not in [m["host"]
                                       for m in self._spec["members"]]):
-                    # a newcomer wants in: break the running generation
-                    print(f"controller: gen {self._gen} break — "
-                          f"newcomer h{h} registered", flush=True)
-                    self._regen_wanted = True
-                    self._lock.notify_all()
+                    # a newcomer wants in
+                    self._break(f"newcomer h{h} registered")
                 self._maybe_cut()
                 return {"gen": self._gen}
             if op == "poll":
@@ -393,12 +401,9 @@ class GroupController:
                 return {"ok": 0, "gen": self._gen, "pending": True}
             if op in ("fail", "leave"):
                 h = int(req["host"])
-                print(f"controller: gen {self._gen} break — "
-                      f"{op} from h{h}", flush=True)
-                self._regen_wanted = True
+                self._break(f"{op} from h{h}")
                 if op == "leave":
                     self._reg.pop(h, None)
-                self._lock.notify_all()
                 return {"ok": 1, "gen": self._gen}
             return {"error": f"unknown op {op!r}"}
 
@@ -426,11 +431,8 @@ class GroupController:
                 if left <= 0:
                     # a member never arrived: the generation is broken
                     missing = members - self._barriers.get(key, set())
-                    print(f"controller: gen {g} break — barrier "
-                          f"round {r} timed out waiting for "
-                          f"{sorted(missing)}", flush=True)
-                    self._regen_wanted = True
-                    self._lock.notify_all()
+                    self._break(f"barrier round {r} timed out waiting "
+                                f"for {sorted(missing)}")
                     return {"ok": 0, "gen": self._gen}
                 self._lock.wait(timeout=min(left, 0.25))
 

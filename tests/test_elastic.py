@@ -159,7 +159,7 @@ def _dump_meta(workdir, h):
     return d[1] if d is not None else None
 
 
-def _wait_leader(dirs, hosts, gen, timeout=150.0):
+def _wait_leader(dirs, hosts, gen, timeout=240.0):
     """Wait until some member's fresh dump (of this generation) claims
     leadership; returns its host id."""
     deadline = time.time() + timeout
@@ -172,7 +172,7 @@ def _wait_leader(dirs, hosts, gen, timeout=150.0):
     raise AssertionError(f"no leader dump for gen {gen}")
 
 
-def _replicated_set(dirs, hosts, key, val, timeout=150.0):
+def _replicated_set(dirs, hosts, key, val, timeout=240.0):
     """Write ``key=val`` through whichever member currently leads and
     wait until every OTHER member's app serves it — retrying across
     leadership moves and generation churn (both are legitimate elastic
@@ -207,7 +207,7 @@ def _replicated_set(dirs, hosts, key, val, timeout=150.0):
         f"(last observed {last!r})")
 
 
-def _wait_gen(ctl, g, timeout=120.0):
+def _wait_gen(ctl, g, timeout=240.0):
     deadline = time.time() + timeout
     while time.time() < deadline:
         with ctl._lock:
@@ -217,7 +217,7 @@ def _wait_gen(ctl, g, timeout=120.0):
     raise AssertionError(f"generation {g} never cut")
 
 
-def _wait_member(ctl, host, after_gen, timeout=150.0):
+def _wait_member(ctl, host, after_gen, timeout=240.0):
     """Wait (across generation churn) for a generation that includes
     ``host``; returns its spec."""
     spec = _wait_gen(ctl, after_gen + 1)
@@ -282,7 +282,7 @@ def test_elastic_loss_restart_rejoin(tmp_path, built_native):
         # the rejoined host serves the FULL history: the gen-1 write it
         # saw before dying AND the gen-2 write it completely missed
         assert _wait_kv(APP_PORTS[victim], b"era", b"first",
-                        timeout=150) == b"first"
+                        timeout=240) == b"first"
         assert _wait_kv(APP_PORTS[victim], b"during", b"outage") == \
             b"outage", "rejoined host missed the write from its outage"
 
@@ -299,7 +299,7 @@ def test_elastic_loss_restart_rejoin(tmp_path, built_native):
         spec4 = _wait_member(ctl, 3, gen3)
         # the joiner serves history it never witnessed...
         assert _wait_kv(APP_PORTS[3], b"era", b"first",
-                        timeout=150) == b"first"
+                        timeout=240) == b"first"
         assert _wait_kv(APP_PORTS[3], b"back", b"three") == b"three"
         # ...and participates in new replication
         members4 = [m["host"] for m in spec4["members"]]
