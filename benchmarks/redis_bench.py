@@ -71,6 +71,13 @@ def main():
                          "follower-equality evidence than the default "
                          "single-key workload)")
     ap.add_argument("--port-base", type=int, default=9860)
+    ap.add_argument("--profile", action="store_true",
+                    help="wall-time phase accounting of the driver poll "
+                         "loop (device step / replay / apply / sync sums)")
+    ap.add_argument("--n-slots", type=int, default=2048)
+    ap.add_argument("--slot-bytes", type=int, default=512)
+    ap.add_argument("--window-slots", type=int, default=64)
+    ap.add_argument("--batch-slots", type=int, default=64)
     args = ap.parse_args()
 
     try:
@@ -85,8 +92,9 @@ def main():
     from rdma_paxos_tpu.config import LogConfig, TimeoutConfig
     from rdma_paxos_tpu.runtime.driver import ClusterDriver
 
-    cfg = LogConfig(n_slots=2048, slot_bytes=512, window_slots=64,
-                    batch_slots=64)
+    cfg = LogConfig(n_slots=args.n_slots, slot_bytes=args.slot_bytes,
+                    window_slots=args.window_slots,
+                    batch_slots=args.batch_slots)
     ports = [args.port_base + i for i in range(args.replicas)]
     wd = tempfile.mkdtemp(prefix="rp_redisbench_")
     subprocess.run(["make", "-C", NATIVE], check=True,
@@ -114,6 +122,47 @@ def main():
                 break
             except OSError:
                 time.sleep(0.1)
+    stats = None
+    if args.profile:
+        # direct wall-time phase accounting on the poll thread (cProfile
+        # mis-attributes C-level waits under load): wraps the driver's
+        # major sub-phases with monotonic sums
+        stats = {"iters": 0, "step_wall": 0.0, "device": 0.0,
+                 "replay_fetch": 0.0, "apply": 0.0, "sync": 0.0,
+                 "loop_wall": [None, None]}
+
+        def timed(obj, name, key):
+            orig = getattr(obj, name)
+
+            def wrap(*a, **kw):
+                t0 = time.monotonic()
+                try:
+                    return orig(*a, **kw)
+                finally:
+                    stats[key] += time.monotonic() - t0
+            setattr(obj, name, wrap)
+
+        timed(driver.cluster, "step", "device")
+        timed(driver.cluster, "step_burst", "device")
+        timed(driver.cluster, "_replay_committed", "replay_fetch")
+        timed(driver, "_apply_new_entries", "apply")
+        for rt in driver.runtimes:
+            if rt.store is not None:
+                timed(rt.store, "sync", "sync")
+        orig_step = driver.step
+
+        def stat_step():
+            if stats["loop_wall"][0] is None:
+                stats["loop_wall"][0] = time.monotonic()
+            t0 = time.monotonic()
+            try:
+                return orig_step()
+            finally:
+                now = time.monotonic()
+                stats["step_wall"] += now - t0
+                stats["iters"] += 1
+                stats["loop_wall"][1] = now
+        driver.step = stat_step
     driver.run(period=0.0005)
     t0 = time.time()
     while driver.leader() < 0:
@@ -152,6 +201,15 @@ def main():
               + ("  OK" if size == lead_size else "  MISMATCH"))
 
     driver.stop()
+    if stats is not None:
+        lw = (stats["loop_wall"][1] - stats["loop_wall"][0]
+              if stats["loop_wall"][0] is not None else 0.0)
+        print(f"phase stats: iters={stats['iters']} "
+              f"loop_wall={lw:.2f}s step_wall={stats['step_wall']:.2f}s "
+              f"device={stats['device']:.2f}s "
+              f"(of which replay_fetch={stats['replay_fetch']:.2f}s) "
+              f"apply={stats['apply']:.2f}s sync={stats['sync']:.2f}s "
+              f"idle={lw - stats['step_wall']:.2f}s")
     for a in apps:
         a.kill()
         a.wait()

@@ -96,6 +96,17 @@ class ProxyServer:
                                     Optional[PendingEvent]],
                  conn_ctr_start: int = 0):
         self.sock_path = sock_path
+        # conn ids pack the origin into bits 24+ of an int32 log column
+        # (M_CONN): an id >= 128 would flip the sign bit and break the
+        # origin test ((conn >> 24) == host_id) everywhere downstream —
+        # fail loudly here rather than hang that host's clients. Elastic
+        # host ids grow monotonically, so long-lived deployments must
+        # recycle ids below 128 (the reference packs node_id<<8 into an
+        # int with the same kind of bound, proxy.c:101-106).
+        if not 0 <= node_id < 128:
+            raise ValueError(
+                f"node_id {node_id} does not fit the conn-id origin "
+                "field (int32 M_CONN allows 0..127)")
         self.node_id = node_id
         self.on_event = on_event
         # namespaced start (elastic generations) so a restarted host's

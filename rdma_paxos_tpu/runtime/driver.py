@@ -206,11 +206,28 @@ class ClusterDriver:
                                         conn=conn, req_id=seq)
                 self._submitq[r].clear()
 
+        # a flagged (force-pruned) leader never heals on its own: it
+        # acks windows and heartbeats normally, so nothing deposes it,
+        # its app/store stay frozen (stale reads), and every other
+        # flagged member's recovery starves behind it. Actively depose
+        # it: fire an election timeout on a healthy member each step
+        # until leadership moves (run_until_elected cadence).
+        depose = -1
+        if (self._leader_view >= 0
+                and self._leader_view in self.cluster.need_recovery):
+            mask = self._mm.current(self._leader_view)["bitmask_new"]
+            healthy = [r for r in range(self.R)
+                       if (mask >> r) & 1 and r != self._leader_view
+                       and r not in self.cluster.need_recovery]
+            if healthy:
+                depose = min(healthy)
+
         # deep submit queue + known leader: drain through a multi-step
         # burst (one dispatch for up to K_TIERS[-1] protocol steps; no
         # election timeouts can fire inside — each burst step carries the
         # heartbeat, so follower timers are beaten right after)
-        if (self._leader_view >= 0 and self.cluster.last is not None
+        if (depose < 0
+                and self._leader_view >= 0 and self.cluster.last is not None
                 and max(len(q) for q in self.cluster.pending)
                 > self.cfg.batch_slots):
             res = self.cluster.step_burst()
@@ -220,12 +237,17 @@ class ClusterDriver:
             for r, rt in enumerate(self.runtimes):
                 if last is not None and last["role"][r] == int(Role.LEADER):
                     continue
-                if rt.timer.expired():
+                if rt.timer.expired() or r == depose:
                     timeouts.append(r)
                     rt.timer.beat()
-                    rt.fired_leader = (int(last["leader_id"][r])
-                                       if last is not None else -1)
-                    rt.fired_countdown = 50
+                    if r != depose:
+                        # a deliberate deposition is not a mistimed
+                        # timeout: it must not feed the adaptive
+                        # false-positive widening (the flagged leader IS
+                        # alive and heartbeating)
+                        rt.fired_leader = (int(last["leader_id"][r])
+                                           if last is not None else -1)
+                        rt.fired_countdown = 50
             res = self.cluster.step(timeouts=timeouts)
 
         with self._lock:

@@ -315,12 +315,35 @@ class GroupController:
         hosts = sorted(self._reg)
         if not hosts:
             return
+
+        def _donor_eligible(h: int) -> bool:
+            m = self._reg[h].get("meta")
+            return bool(m) and bool(int(m.get("usable", 1)))
+
+        group_has_history = any(self._reg[h].get("meta") for h in hosts)
         if self._prev_members:
             # survivors must include a majority of the previous world,
             # else the donor cannot be proven complete (Raft overlap)
             maj = len(self._prev_members) // 2 + 1
-            if len(set(hosts) & set(self._prev_members)) < maj:
+            prev = set(self._prev_members)
+            if len(prev.intersection(hosts)) < maj:
                 return
+            # When the group HAS history, only DONOR-ELIGIBLE survivors
+            # count toward that majority: the donor election below skips
+            # force-pruned laggards (usable=0) and meta-less
+            # registrations, so letting them justify the cut could
+            # elect a donor missing a committed entry whose only
+            # surviving holder is the unusable host (commit acked by
+            # leader+wedged follower, leader dies, third follower
+            # lags) — the cut must wait for a provably complete donor
+            # set. When NO survivor has any meta (every disk was lost),
+            # there is nothing recoverable anywhere: fall through to
+            # the fresh-world cut below rather than deadlock.
+            if group_has_history:
+                eligible = [h for h in hosts
+                            if h in prev and _donor_eligible(h)]
+                if len(eligible) < maj:
+                    return
         elif len(hosts) < self.expect:
             return
         if time.monotonic() - self._reg_changed < self.settle:
@@ -381,6 +404,10 @@ class GroupController:
         with self._lock:
             if op == "register":
                 h = int(req["host"])
+                if not 0 <= h < 128:
+                    # never admit an id the proxy layer cannot encode:
+                    # a generation containing it would crash on spawn
+                    return {"error": f"host id {h} out of range 0..127"}
                 self._reg[h] = {"addr": req["addr"],
                                 "meta": req.get("meta")}
                 self._reg_changed = time.monotonic()
@@ -485,6 +512,16 @@ class ElasticSupervisor:
                  port: int = 0, app_port: int = 0, app_cmd: str = "",
                  round_iters: int = 25, cfg_json: str = "",
                  worker_env: Optional[dict] = None):
+        # conn ids pack the host id into bits 24+ of an int32 log column;
+        # enforce the bound HERE (where elastic host ids are chosen) so
+        # an oversized id fails one supervisor at startup instead of
+        # crashing every generation that includes it (the worker's
+        # ProxyServer would raise the same bound mid-generation,
+        # breaking the whole world in a regen loop)
+        if not 0 <= host_id < 128:
+            raise ValueError(
+                f"host_id {host_id} out of range: conn-id origin field "
+                "allows 0..127 — recycle retired host ids")
         self.host_id = host_id
         self.controller = controller
         self.workdir = workdir
@@ -730,12 +767,18 @@ def main() -> None:
     ap.add_argument("--app-cmd", default="")
     ap.add_argument("--round-iters", type=int, default=25)
     ap.add_argument("--cfg-json", default="")
+    ap.add_argument("--worker-cpu", action="store_true",
+                    help="run worker consensus cores on the CPU backend "
+                         "(sets RP_BENCH_CPU=1 for workers; without this "
+                         "workers inherit the environment's backend — on "
+                         "a TPU host that means the TPU)")
     args = ap.parse_args()
     sup = ElasticSupervisor(
         host_id=args.host_id, controller=args.controller,
         workdir=args.workdir, port=args.port, app_port=args.app_port,
         app_cmd=args.app_cmd, round_iters=args.round_iters,
-        cfg_json=args.cfg_json)
+        cfg_json=args.cfg_json,
+        worker_env={"RP_BENCH_CPU": "1"} if args.worker_cpu else None)
     print(f"supervisor h{args.host_id} serving on {sup.addr}",
           flush=True)
     try:

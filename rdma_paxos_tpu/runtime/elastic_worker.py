@@ -46,11 +46,24 @@ def main() -> None:
     slot = members.index(args.host_id)
     M = len(members)
 
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # Backend selection: force CPU only when EXPLICITLY requested
+    # (RP_BENCH_CPU=1); otherwise the worker inherits the environment's
+    # backend, so a TPU deployment runs the consensus core on the TPU
+    # rather than silently falling to CPU (advisor finding r3). The
+    # override must go through jax.config — a sitecustomize may have
+    # force-set jax_platforms at interpreter start, which an env var
+    # cannot undo. The choice is logged so a misconfig is visible.
+    force_cpu = os.environ.get("RP_BENCH_CPU") == "1"
+    if force_cpu:
+        os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ.pop("XLA_FLAGS", None)     # one device per process
     import jax
-    if os.environ.get("RP_BENCH_CPU", "1") == "1":
+    if force_cpu:
         jax.config.update("jax_platforms", "cpu")
+    print(f"worker h{args.host_id}: backend="
+          f"{'cpu (forced, RP_BENCH_CPU=1)' if force_cpu else 'inherited'}"
+          f" JAX_PLATFORMS={os.environ.get('JAX_PLATFORMS', '<default>')}",
+          flush=True)
 
     from rdma_paxos_tpu.config import LogConfig, TimeoutConfig
     from rdma_paxos_tpu.consensus.snapshot import genesis_row

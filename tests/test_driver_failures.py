@@ -62,6 +62,29 @@ def test_driver_snapshot_recovery_path():
     d.stop()
 
 
+def test_flagged_leader_is_deposed_and_recovered():
+    """A force-pruned replica that holds leadership acks windows and
+    heartbeats normally, so nothing deposes it naturally — its app and
+    store stay frozen (stale reads) and every other flagged member's
+    recovery starves behind it. The driver must actively depose it by
+    firing a healthy member's election timeout, then heal it once
+    leadership has moved."""
+    d = make_driver()
+    d.cluster.run_until_elected(0)
+    d.step()
+    assert d.leader() == 0
+    d.cluster.need_recovery.add(0)
+    for _ in range(50):
+        d.step()
+        if d.leader() not in (-1, 0) and not d.cluster.need_recovery:
+            break
+    assert d.leader() >= 0 and d.leader() != 0, (
+        "flagged leader was never deposed")
+    assert not d.cluster.need_recovery, (
+        "deposed ex-leader was never recovered")
+    d.stop()
+
+
 def test_poll_loop_crash_releases_and_rejects_events():
     """A step exception on the poll thread must fail every blocked
     commit waiter AND fail-fast any event arriving afterwards — app

@@ -113,6 +113,81 @@ def test_genesis_boot_in_sim():
 
 
 # ---------------------------------------------------------------------------
+# unit level: controller cut safety
+# ---------------------------------------------------------------------------
+
+def test_controller_unusable_survivors_cannot_justify_cut():
+    """A cut must wait until donor-ELIGIBLE survivors alone include a
+    majority of the previous world. Scenario: commit acked by leader +
+    a follower that then wedges (usable=0); leader dies; the remaining
+    follower lags. The wedged follower is the only surviving holder of
+    the committed entry, so cutting with the laggard as donor would
+    silently drop an acked write — the controller must refuse until a
+    provably complete donor set registers."""
+    from rdma_paxos_tpu.runtime.elastic import GroupController
+    ctl = GroupController(expect=3, settle=0.0)
+    try:
+        full = dict(term=5, last_log_term=5, end=10, commit=10,
+                    apply=10, applied=10, leader=1, usable=1)
+        for h in range(3):
+            ctl._handle({"op": "register", "host": h,
+                         "addr": "127.0.0.1:1", "meta": None})
+        assert ctl._spec is not None and ctl._spec["gen"] == 1
+        ctl._handle({"op": "fail", "host": 1, "gen": 1})
+        wedged = dict(full, leader=0, usable=0)
+        laggard = dict(full, leader=0, end=5, commit=5, apply=5,
+                       applied=5)
+        ctl._handle({"op": "register", "host": 1,
+                     "addr": "127.0.0.1:1", "meta": wedged})
+        ctl._handle({"op": "register", "host": 2,
+                     "addr": "127.0.0.1:1", "meta": laggard})
+        r = ctl._handle({"op": "poll", "host": 2})
+        # supervisors ignore spec gens they already ran; the check is
+        # that no NEW generation was cut from this survivor set
+        assert r["gen"] == 1, (
+            "cut proceeded with 1 donor-eligible survivor of 3 — the "
+            "wedged follower's committed entries would be dropped")
+        # the dead leader returns with its complete log: two eligible
+        # survivors now overlap the previous world -> cut, donor = the
+        # most up-to-date ELIGIBLE host
+        ctl._handle({"op": "register", "host": 0,
+                     "addr": "127.0.0.1:1", "meta": dict(full)})
+        r = ctl._handle({"op": "poll", "host": 0})
+        assert r.get("ok") and r["gen"] == 2
+        assert r["donor"] == 0
+    finally:
+        ctl.close()
+
+
+def test_controller_all_meta_less_survivors_cut_fresh_world():
+    """When EVERY surviving registration is meta-less (all disks lost),
+    nothing is recoverable anywhere: the controller must cut a fresh
+    world (donor -1) rather than deadlock waiting for an eligible donor
+    that can never appear."""
+    from rdma_paxos_tpu.runtime.elastic import GroupController
+    ctl = GroupController(expect=3, settle=0.0)
+    try:
+        for h in range(3):
+            ctl._handle({"op": "register", "host": h,
+                         "addr": "127.0.0.1:1", "meta": None})
+        assert ctl._spec is not None and ctl._spec["gen"] == 1
+        ctl._handle({"op": "fail", "host": 0, "gen": 1})
+        for h in range(3):
+            ctl._handle({"op": "register", "host": h,
+                         "addr": "127.0.0.1:1", "meta": None})
+        r = ctl._handle({"op": "poll", "host": 0})
+        assert r.get("ok") and r["gen"] == 2, r
+        assert r["donor"] == -1
+        # oversized host ids are refused at the door (the proxy layer
+        # cannot encode them) — they must never enter a generation
+        r = ctl._handle({"op": "register", "host": 128,
+                         "addr": "127.0.0.1:1", "meta": None})
+        assert "error" in r
+    finally:
+        ctl.close()
+
+
+# ---------------------------------------------------------------------------
 # full multi-process scenario
 # ---------------------------------------------------------------------------
 
@@ -243,8 +318,13 @@ def test_elastic_loss_restart_rejoin(tmp_path, built_native):
     ctl = GroupController(expect=3, settle=1.2, barrier_timeout=90.0)
     dirs = {h: str(tmp_path / f"h{h}") for h in range(3)}
     cache = "/tmp/rp_elastic_jaxcache"
+    # tests opt into the CPU backend EXPLICITLY (workers no longer
+    # default to CPU — a silent CPU fallback on a TPU deployment was an
+    # advisor finding); the outer environment may carry an accelerator
+    # JAX_PLATFORMS that must not leak into the worker world
     wenv = {"JAX_COMPILATION_CACHE_DIR": cache,
-            "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "1"}
+            "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "1",
+            "RP_BENCH_CPU": "1"}
 
     def mk_sup(h):
         sup = ElasticSupervisor(
