@@ -1,4 +1,5 @@
-// interpose — LD_PRELOAD syscall-interposition shim (pipelined).
+// interpose — LD_PRELOAD syscall-interposition shim (pipelined +
+// speculative output commit).
 //
 // Native-equivalent of the reference's spec_hooks.cpp: hooks
 // __libc_start_main (init before the app's main, :48-100), accept/accept4
@@ -6,28 +7,44 @@
 // fstat S_IFSOCK (:113-116). Where the reference calls straight into the
 // in-process proxy (proxy_on_accept/read/close, rsm-interface.h:12-15),
 // this shim forwards each event over a Unix domain socket to the replica
-// driver daemon and blocks the CALLING THREAD until the driver
-// acknowledges — on the leader the ack arrives only after the event is
-// committed by the consensus core, reproducing the reference's
-// spin-until-committed-and-applied semantics (proxy.c:160).
+// driver daemon.
 //
-// Pipelined: the reference splits its hot path into a spinlock-protected
-// tailq INSERT followed by a per-thread spin on the commit counter
-// (proxy.c:114-160), so every app thread can have an event in flight
-// concurrently. This shim does the same: the socket write (the enqueue)
-// holds a short mutex, a dedicated reader thread distributes seq-tagged
-// responses, and each app thread waits only for ITS OWN event — a
-// multithreaded app commits many events per commit-latency, instead of
-// one per process.
+// TWO commit-wait disciplines:
+//
+// * SYNC (RP_SPEC=0): the calling thread blocks inside read() until the
+//   driver acks — the reference's spin-until-committed-and-applied
+//   semantics (proxy.c:160), pipelined across threads (each app thread
+//   waits only for ITS OWN event).
+//
+// * SPECULATIVE (default): read() forwards the inbound bytes to the
+//   driver and returns IMMEDIATELY — the app executes on not-yet-
+//   committed input — while the shim additionally hooks the app's
+//   OUTPUT syscalls (write/send/writev/sendmsg) on tracked client fds
+//   and holds every reply until the commit frontier covers all input
+//   events forwarded before that reply was produced (output commit).
+//   Externally the guarantee is unchanged — a client that HAS a reply
+//   knows its request committed — but the app's event loop never
+//   stalls, so a single-threaded server (redis) keeps a deep pipeline
+//   of events in flight instead of one-read-per-commit-RTT. This is
+//   the TPU-native redesign of the reference's µs-scale blocking hot
+//   path: with a host-loop commit latency in the milliseconds, blocking
+//   the app thread caps throughput at one read-buffer per RTT;
+//   speculation + output commit decouples app execution rate from
+//   commit latency entirely. Mis-speculation (a deposed leader whose
+//   app consumed input that never committed) is surfaced to the driver,
+//   which quarantines the app until it is restarted and rebuilt from
+//   the committed store (ClusterDriver.reset_app).
 //
 // Env:
 //   RP_PROXY_SOCK  — path of the driver's Unix socket. Unset => all hooks
 //                    pass through untouched (the app runs unreplicated).
+//   RP_SPEC        — "0" selects the SYNC discipline (default "1").
 //
-// Wire format (little-endian):
+// Wire format (little-endian), unchanged from the sync-only revision:
 //   request : [u8 op][u32 seq][i32 fd][u32 len][len bytes]
 //                                  op: 1=HELLO 2=CONNECT 3=SEND 4=CLOSE
 //   response: [u32 seq][i32 status]   >=0 ok / pass; <0 drop connection
+//   HELLO carries one payload byte: bit0 = speculative mode.
 //
 // Build: make -C native  ->  interpose.so
 
@@ -35,14 +52,19 @@
 #include <cstdio>
 #include <cstring>
 
+#include <deque>
+#include <string>
+
 #include <dlfcn.h>
 #include <errno.h>
 #include <fcntl.h>
 #include <netinet/in.h>
+#include <poll.h>
 #include <pthread.h>
 #include <stdlib.h>
 #include <sys/socket.h>
 #include <sys/stat.h>
+#include <sys/uio.h>
 #include <sys/un.h>
 #include <unistd.h>
 
@@ -53,38 +75,80 @@ enum Op : uint8_t { OP_HELLO = 1, OP_CONNECT = 2, OP_SEND = 3, OP_CLOSE = 4 };
 using accept_fn = int (*)(int, struct sockaddr*, socklen_t*);
 using accept4_fn = int (*)(int, struct sockaddr*, socklen_t*, int);
 using read_fn = ssize_t (*)(int, void*, size_t);
+using write_fn = ssize_t (*)(int, const void*, size_t);
+using send_fn = ssize_t (*)(int, const void*, size_t, int);
+using writev_fn = ssize_t (*)(int, const struct iovec*, int);
+using sendmsg_fn = ssize_t (*)(int, const struct msghdr*, int);
 using close_fn = int (*)(int);
 using main_fn = int (*)(int, char**, char**);
 
 accept_fn real_accept;
 accept4_fn real_accept4;
 read_fn real_read;
+write_fn real_write;
+send_fn real_send;
+writev_fn real_writev;
+sendmsg_fn real_sendmsg;
 close_fn real_close;
 main_fn real_main;
 
 int proxy_fd = -1;                    // UDS to the driver daemon
+bool spec_mode = true;                // RP_SPEC != "0"
 pthread_mutex_t send_mu = PTHREAD_MUTEX_INITIALIZER;  // write serialization
 constexpr int kMaxFd = 65536;
 unsigned char tracked[kMaxFd];        // fds that arrived through accept()
+unsigned char severed[kMaxFd];        // negative-acked: drop held output
+uint32_t fd_gen[kMaxFd];              // bumps on real close (reuse guard)
 
-// ---- pipelined response plumbing -----------------------------------------
+// ---- outstanding-event ring (ack bookkeeping) ----------------------------
+//
+// Every forwarded event claims one monotone 64-bit seq; the ring slot at
+// seq % kRing tracks its ack. The FRONTIER is the largest seq such that
+// every seq <= it is acked — held replies whose watermark is <= the
+// frontier are releasable (all input the app had consumed when the reply
+// was produced has committed). The wire carries the low 32 seq bits;
+// outstanding count < kRing << 2^32, so slot.seq disambiguates.
 
-constexpr int kPendingCap = 256;      // max in-flight events per process
-struct Pending {
-  uint32_t seq;                       // 0 = slot free
+constexpr uint32_t kRing = 1 << 15;   // max outstanding events
+enum SlotState : uint8_t { FREE = 0, SENT = 1, DONE = 2 };
+struct AckSlot {
+  uint64_t seq;
   int32_t status;
-  bool done;
+  SlotState state;
+  int32_t fd;                         // tracked fd (sever on negative ack)
+  uint32_t gen;
+  bool waited;                        // a sync caller will consume status
 };
-Pending pending[kPendingCap];
+AckSlot ring[kRing];
 pthread_mutex_t resp_mu = PTHREAD_MUTEX_INITIALIZER;
 pthread_cond_t resp_cv = PTHREAD_COND_INITIALIZER;
-uint32_t next_seq = 1;
+uint64_t next_seq = 1;
+uint64_t frontier = 0;                // all seqs <= frontier are acked
+uint64_t last_sent = 0;               // last seq claimed (any op)
 bool driver_dead = false;
+
+// ---- held output (speculative mode) --------------------------------------
+
+struct OutChunk {
+  int32_t fd;
+  uint32_t gen;
+  uint64_t watermark;                 // flush once frontier >= watermark
+  bool is_close;                      // real_close(fd) instead of write
+  std::string data;
+};
+std::deque<OutChunk>* outq;           // FIFO; watermarks are monotone
+size_t outq_bytes = 0;
+bool flushing = false;                // exactly one flusher at a time
+constexpr size_t kOutCap = 64u << 20; // writer backpressure bound
 
 void resolve() {
   real_accept = (accept_fn)dlsym(RTLD_NEXT, "accept");
   real_accept4 = (accept4_fn)dlsym(RTLD_NEXT, "accept4");
   real_read = (read_fn)dlsym(RTLD_NEXT, "read");
+  real_write = (write_fn)dlsym(RTLD_NEXT, "write");
+  real_send = (send_fn)dlsym(RTLD_NEXT, "send");
+  real_writev = (writev_fn)dlsym(RTLD_NEXT, "writev");
+  real_sendmsg = (sendmsg_fn)dlsym(RTLD_NEXT, "sendmsg");
   real_close = (close_fn)dlsym(RTLD_NEXT, "close");
 }
 
@@ -92,7 +156,7 @@ bool io_exact(int fd, void* buf, size_t n, bool writing) {
   size_t done = 0;
   while (done < n) {
     ssize_t r = writing
-        ? write(fd, static_cast<char*>(buf) + done, n - done)
+        ? real_write(fd, static_cast<char*>(buf) + done, n - done)
         : real_read(fd, static_cast<char*>(buf) + done, n - done);
     if (r < 0 && errno == EINTR) continue;  // signals during the commit
                                             // wait must not kill the link
@@ -102,69 +166,152 @@ bool io_exact(int fd, void* buf, size_t n, bool writing) {
   return true;
 }
 
-// Reader thread: distributes seq-tagged responses to waiting app threads.
-// EOF / error => the driver died: stop interposing, release every waiter
-// with pass-through status 0 (the app keeps serving unreplicated — same
-// fallback as before, now process-wide in one place).
+// Write held bytes to the app's client socket. Blocking (the fd is the
+// app's; a pathologically slow client stalls the flusher and thus all
+// held output — global backpressure, the same failure mode as the
+// reference leader writing replies synchronously from the app thread).
+void flush_write(int fd, const std::string& data) {
+  size_t done = 0;
+  while (done < data.size()) {
+    // MSG_NOSIGNAL: a vanished client must not SIGPIPE the flusher
+    ssize_t r = real_send(fd, data.data() + done, data.size() - done,
+                          MSG_NOSIGNAL);
+    if (r < 0 && errno == EINTR) continue;
+    if (r < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+      // event-loop apps set client fds O_NONBLOCK: a full socket
+      // buffer is backpressure, not death — wait for drainage
+      struct pollfd p;
+      p.fd = fd;
+      p.events = POLLOUT;
+      if (poll(&p, 1, 5000) <= 0) return;  // stuck client: drop
+      continue;
+    }
+    if (r <= 0) return;               // client died: drop the remainder
+    done += static_cast<size_t>(r);
+  }
+}
+
+// Release every held chunk whose watermark the frontier now covers.
+// Called with resp_mu held; drops the lock across the actual writes
+// (the socket write must not serialize ack processing). The `flushing`
+// flag keeps exactly one active flusher — two threads draining the
+// queue concurrently could reorder same-fd replies — and gates the
+// hold_output fast path for the same reason.
+void flush_outq_locked() {
+  if (flushing) return;               // the active flusher will pick up
+  flushing = true;
+  while (outq && !outq->empty() && outq->front().watermark <= frontier) {
+    OutChunk c = std::move(outq->front());
+    outq->pop_front();
+    outq_bytes -= c.data.size();
+    // gen mismatch => the fd number was really closed (and possibly
+    // reused by a NEW connection) since this chunk was queued: skip it
+    // entirely — data must never leak to a different client, and a
+    // stale close chunk's fd is no longer ours to close. The gen bump
+    // for a deferred close happens HERE, under resp_mu, so the reader
+    // thread's generation checks can never race it.
+    bool gen_ok = c.fd >= 0 && c.fd < kMaxFd && fd_gen[c.fd] == c.gen;
+    bool do_close = gen_ok && c.is_close;
+    bool do_write = gen_ok && !c.is_close && !severed[c.fd];
+    if (do_close) fd_gen[c.fd]++;     // deferred real close (severed or not)
+    pthread_cond_broadcast(&resp_cv);   // space freed for blocked writers
+    pthread_mutex_unlock(&resp_mu);
+    if (do_close) real_close(c.fd);
+    else if (do_write) flush_write(c.fd, c.data);
+    pthread_mutex_lock(&resp_mu);
+  }
+  flushing = false;
+}
+
+// Advance the frontier over contiguous DONE slots, freeing them; then
+// flush newly releasable held output. resp_mu held.
+void advance_frontier_locked() {
+  bool moved = false;
+  for (;;) {
+    AckSlot& s = ring[(frontier + 1) % kRing];
+    if (s.state != DONE || s.seq != frontier + 1 || s.waited) break;
+    s.state = FREE;
+    frontier++;
+    moved = true;
+  }
+  if (moved) {
+    pthread_cond_broadcast(&resp_cv);
+    flush_outq_locked();
+  }
+}
+
+// Reader thread: distributes seq-tagged responses. EOF / error => the
+// driver died: stop interposing, release every waiter and all held
+// output (the app keeps serving unreplicated — same fallback as the
+// sync design, process-wide in one place).
 void* reader_main(void*) {
   for (;;) {
     uint8_t buf[8];
     if (!io_exact(proxy_fd, buf, sizeof buf, false)) break;
-    uint32_t seq;
+    uint32_t wseq;
     int32_t status;
-    memcpy(&seq, buf, 4);
+    memcpy(&wseq, buf, 4);
     memcpy(&status, buf + 4, 4);
     pthread_mutex_lock(&resp_mu);
-    for (int i = 0; i < kPendingCap; i++) {
-      if (pending[i].seq == seq) {
-        pending[i].status = status;
-        pending[i].done = true;
-        break;
+    // the slot index depends only on the low bits of the 64-bit seq,
+    // which equal the low bits of the wire seq
+    AckSlot& s = ring[wseq % kRing];
+    if (s.state == SENT && (uint32_t)s.seq == wseq) {
+      s.status = status;
+      s.state = DONE;
+      if (status < 0 && s.fd >= 0 && s.fd < kMaxFd &&
+          fd_gen[s.fd] == s.gen) {
+        // the driver refused this event (leadership lost): the bytes
+        // must never be acked to the client — sever the connection and
+        // drop its held output so the client retries elsewhere
+        severed[s.fd] = 1;
+        tracked[s.fd] = 0;
+        shutdown(s.fd, SHUT_RDWR);
       }
+      if (s.waited)
+        pthread_cond_broadcast(&resp_cv);   // sync caller consumes it
+      else
+        advance_frontier_locked();
     }
-    pthread_cond_broadcast(&resp_cv);
     pthread_mutex_unlock(&resp_mu);
   }
   pthread_mutex_lock(&resp_mu);
   driver_dead = true;
   proxy_fd = -1;                      // hooks pass through from now on
+  frontier = last_sent;               // release everything held
+  flush_outq_locked();
   pthread_cond_broadcast(&resp_cv);
   pthread_mutex_unlock(&resp_mu);
   return nullptr;
 }
 
-// Send one event and wait for the driver's verdict. The calling thread
-// blocks; other threads' events proceed concurrently.
-int32_t proxy_call(uint8_t op, int32_t fd, const void* data, uint32_t len) {
-  if (proxy_fd < 0) return 0;
-
-  // claim a pending slot + a seq (the tailq-insert half)
-  pthread_mutex_lock(&resp_mu);
-  int slot = -1;
+// Claim a seq + ring slot (resp_mu held). Waits if the ring is full.
+// Returns 0 on driver death.
+uint64_t claim_slot_locked(int32_t fd, bool waited) {
   for (;;) {
-    if (driver_dead) {
-      pthread_mutex_unlock(&resp_mu);
-      return 0;
-    }
-    for (int i = 0; i < kPendingCap; i++) {
-      if (pending[i].seq == 0) {
-        slot = i;
-        break;
-      }
-    }
-    if (slot >= 0) break;
-    pthread_cond_wait(&resp_cv, &resp_mu);   // all slots in flight
+    if (driver_dead) return 0;
+    AckSlot& s = ring[next_seq % kRing];
+    if (s.state == FREE) break;
+    pthread_cond_wait(&resp_cv, &resp_mu);  // ring full: wait for acks
   }
-  uint32_t seq = next_seq++;
-  if (next_seq == 0) next_seq = 1;
-  pending[slot].seq = seq;
-  pending[slot].status = 0;
-  pending[slot].done = false;
-  pthread_mutex_unlock(&resp_mu);
+  uint64_t seq = next_seq++;
+  AckSlot& s = ring[seq % kRing];
+  s.seq = seq;
+  s.status = 0;
+  s.state = SENT;
+  s.fd = fd;
+  s.gen = (fd >= 0 && fd < kMaxFd) ? fd_gen[fd] : 0;
+  s.waited = waited;
+  last_sent = seq;
+  return seq;
+}
 
+bool send_event(uint64_t seq, uint8_t op, int32_t fd, const void* data,
+                uint32_t len) {
   uint8_t hdr[13];
+  uint32_t wseq = (uint32_t)seq;
   hdr[0] = op;
-  memcpy(hdr + 1, &seq, 4);
+  memcpy(hdr + 1, &wseq, 4);
   memcpy(hdr + 5, &fd, 4);
   memcpy(hdr + 9, &len, 4);
   pthread_mutex_lock(&send_mu);       // short: enqueue order only
@@ -173,23 +320,106 @@ int32_t proxy_call(uint8_t op, int32_t fd, const void* data, uint32_t len) {
             (len == 0 ||
              io_exact(pfd, const_cast<void*>(data), len, true));
   pthread_mutex_unlock(&send_mu);
+  return ok;
+}
+
+// Synchronous event: send and wait for the driver's verdict (CONNECT
+// always; SEND/CLOSE in sync mode). Other threads' events proceed
+// concurrently (per-thread slots).
+int32_t proxy_call(uint8_t op, int32_t fd, const void* data, uint32_t len) {
+  if (proxy_fd < 0) return 0;
+  pthread_mutex_lock(&resp_mu);
+  uint64_t seq = claim_slot_locked(fd, /*waited=*/true);
+  if (seq == 0) {
+    pthread_mutex_unlock(&resp_mu);
+    return 0;
+  }
+  pthread_mutex_unlock(&resp_mu);
+
+  bool ok = send_event(seq, op, fd, data, len);
 
   pthread_mutex_lock(&resp_mu);
+  AckSlot& s = ring[seq % kRing];
   if (!ok) driver_dead = true;
-  while (!pending[slot].done && !driver_dead)
+  while (s.state != DONE && !driver_dead)
     pthread_cond_wait(&resp_cv, &resp_mu);
-  int32_t status = driver_dead ? 0 : pending[slot].status;
-  pending[slot].seq = 0;              // free the slot
-  pthread_cond_broadcast(&resp_cv);   // wake slot-waiters
-  if (driver_dead) proxy_fd = -1;
+  int32_t status = driver_dead ? 0 : s.status;
+  s.waited = false;                   // frontier may now pass this slot
+  if (s.state != DONE) s.state = DONE;
+  advance_frontier_locked();
+  if (driver_dead) {
+    proxy_fd = -1;
+    frontier = last_sent;
+    flush_outq_locked();
+    pthread_cond_broadcast(&resp_cv);
+  }
   pthread_mutex_unlock(&resp_mu);
   return status;
+}
+
+// Asynchronous event (speculative mode SEND/CLOSE): forward and return.
+// The ack is consumed by the reader thread; ordering/visibility is
+// enforced at output time via the frontier.
+void proxy_cast(uint8_t op, int32_t fd, const void* data, uint32_t len) {
+  if (proxy_fd < 0) return;
+  pthread_mutex_lock(&resp_mu);
+  uint64_t seq = claim_slot_locked(fd, /*waited=*/false);
+  pthread_mutex_unlock(&resp_mu);
+  if (seq == 0) return;
+  if (!send_event(seq, op, fd, data, len)) {
+    pthread_mutex_lock(&resp_mu);
+    driver_dead = true;
+    proxy_fd = -1;
+    frontier = last_sent;
+    flush_outq_locked();
+    pthread_cond_broadcast(&resp_cv);
+    pthread_mutex_unlock(&resp_mu);
+  }
+}
+
+// Hold (or pass) app output on a tracked fd. Returns the byte count the
+// app should believe it wrote.
+ssize_t hold_output(int fd, const void* buf, size_t count) {
+  pthread_mutex_lock(&resp_mu);
+  if (severed[fd]) {
+    pthread_mutex_unlock(&resp_mu);
+    errno = ECONNRESET;
+    return -1;
+  }
+  // fast path: nothing speculative outstanding, nothing queued, and no
+  // flusher mid-write — the reply depends only on committed input and
+  // cannot overtake a held one, so write straight through
+  if ((!outq || outq->empty()) && frontier >= last_sent && !flushing) {
+    pthread_mutex_unlock(&resp_mu);
+    return real_write(fd, buf, count);
+  }
+  while (outq_bytes > kOutCap && !driver_dead)
+    pthread_cond_wait(&resp_cv, &resp_mu);  // backpressure the app
+  if (driver_dead) {
+    // the death handler already drained outq and nobody will ever
+    // flush again — queueing now would strand this reply forever
+    pthread_mutex_unlock(&resp_mu);
+    return real_write(fd, buf, count);
+  }
+  if (!outq) outq = new std::deque<OutChunk>();
+  OutChunk c;
+  c.fd = fd;
+  c.gen = fd_gen[fd];
+  c.watermark = last_sent;
+  c.is_close = false;
+  c.data.assign(static_cast<const char*>(buf), count);
+  outq_bytes += count;
+  outq->push_back(std::move(c));
+  pthread_mutex_unlock(&resp_mu);
+  return (ssize_t)count;
 }
 
 void rp_init() {
   resolve();
   const char* path = getenv("RP_PROXY_SOCK");
   if (!path) return;
+  const char* spec = getenv("RP_SPEC");
+  spec_mode = !(spec && spec[0] == '0');
   int fd = socket(AF_UNIX, SOCK_STREAM, 0);
   if (fd < 0) return;
   struct sockaddr_un addr;
@@ -209,8 +439,9 @@ void rp_init() {
     return;
   }
   pthread_detach(thr);
+  uint8_t flags = spec_mode ? 1 : 0;
   int32_t pid = static_cast<int32_t>(getpid());
-  proxy_call(OP_HELLO, pid, nullptr, 0);
+  proxy_call(OP_HELLO, pid, &flags, 1);
 }
 
 bool is_socket(int fd) {
@@ -221,6 +452,7 @@ bool is_socket(int fd) {
 void on_accepted(int fd) {
   if (fd >= 0 && fd < kMaxFd && is_socket(fd)) {
     tracked[fd] = 1;
+    severed[fd] = 0;
     // CONNECT carries the peer's address so the driver can tell its own
     // replay connections apart from real clients.
     uint8_t info[6] = {0, 0, 0, 0, 0, 0};
@@ -277,13 +509,19 @@ int accept4(int sockfd, struct sockaddr* addr, socklen_t* addrlen,
 ssize_t read(int fd, void* buf, size_t count) {
   if (!real_read) resolve();
   ssize_t n = real_read(fd, buf, count);
-  // Replicate inbound client bytes before the app acts on them; the
-  // driver's ack means "committed by a quorum" on the leader. A negative
-  // status means the event could NOT be committed (e.g. leadership was
-  // lost mid-session): the bytes must never reach the app, so the
-  // connection is severed and the client retries against the new leader.
+  // Replicate inbound client bytes. SYNC: block until the driver acks
+  // (ack == committed on the leader); a negative status means the event
+  // could NOT be committed (e.g. leadership was lost mid-session): the
+  // bytes must never reach the app, so the connection is severed and
+  // the client retries against the new leader. SPECULATIVE: forward and
+  // return — the app executes immediately; its replies are held until
+  // the commit frontier covers this event (output commit), and a late
+  // negative ack severs the fd from the reader thread.
   if (n > 0 && proxy_fd >= 0 && fd >= 0 && fd < kMaxFd && tracked[fd]) {
-    if (proxy_call(OP_SEND, fd, buf, static_cast<uint32_t>(n)) < 0) {
+    if (spec_mode) {
+      proxy_cast(OP_SEND, fd, buf, static_cast<uint32_t>(n));
+    } else if (proxy_call(OP_SEND, fd, buf,
+                          static_cast<uint32_t>(n)) < 0) {
       tracked[fd] = 0;
       shutdown(fd, SHUT_RDWR);
       errno = ECONNRESET;
@@ -293,11 +531,93 @@ ssize_t read(int fd, void* buf, size_t count) {
   return n;
 }
 
+ssize_t write(int fd, const void* buf, size_t count) {
+  if (!real_write) resolve();
+  if (spec_mode && proxy_fd >= 0 && fd >= 0 && fd < kMaxFd && tracked[fd])
+    return hold_output(fd, buf, count);
+  return real_write(fd, buf, count);
+}
+
+ssize_t send(int sockfd, const void* buf, size_t len, int flags) {
+  if (!real_send) resolve();
+  if (spec_mode && proxy_fd >= 0 && sockfd >= 0 && sockfd < kMaxFd &&
+      tracked[sockfd])
+    return hold_output(sockfd, buf, len);
+  return real_send(sockfd, buf, len, flags);
+}
+
+ssize_t writev(int fd, const struct iovec* iov, int iovcnt) {
+  if (!real_writev) resolve();
+  if (spec_mode && proxy_fd >= 0 && fd >= 0 && fd < kMaxFd && tracked[fd]) {
+    ssize_t total = 0;
+    for (int i = 0; i < iovcnt; i++) {
+      if (iov[i].iov_len == 0) continue;
+      ssize_t r = hold_output(fd, iov[i].iov_base, iov[i].iov_len);
+      if (r < 0) return total > 0 ? total : r;
+      total += r;
+    }
+    return total;
+  }
+  return real_writev(fd, iov, iovcnt);
+}
+
+ssize_t sendmsg(int sockfd, const struct msghdr* msg, int flags) {
+  if (!real_sendmsg) resolve();
+  if (spec_mode && proxy_fd >= 0 && sockfd >= 0 && sockfd < kMaxFd &&
+      tracked[sockfd]) {
+    ssize_t total = 0;
+    for (size_t i = 0; i < msg->msg_iovlen; i++) {
+      if (msg->msg_iov[i].iov_len == 0) continue;
+      ssize_t r = hold_output(sockfd, msg->msg_iov[i].iov_base,
+                              msg->msg_iov[i].iov_len);
+      if (r < 0) return total > 0 ? total : r;
+      total += r;
+    }
+    return total;
+  }
+  return real_sendmsg(sockfd, msg, flags);
+}
+
 int close(int fd) {
   if (!real_close) resolve();
   if (proxy_fd >= 0 && fd >= 0 && fd < kMaxFd && tracked[fd]) {
     tracked[fd] = 0;
+    if (spec_mode) {
+      // the CLOSE is sequenced after this fd's pending input, and the
+      // real close is deferred behind any held replies (a reply must
+      // reach the client before its connection is torn down); the fd
+      // number stays open until then, so the kernel cannot reuse it
+      proxy_cast(OP_CLOSE, fd, nullptr, 0);
+      pthread_mutex_lock(&resp_mu);
+      // defer also while a flusher is mid-write: it may be blocked
+      // inside the last popped chunk for THIS fd with resp_mu dropped —
+      // closing now would truncate that reply (or race an fd reuse)
+      bool defer = ((outq && !outq->empty()) || flushing) && !driver_dead;
+      if (defer) {
+        if (!outq) outq = new std::deque<OutChunk>();
+        OutChunk c;
+        c.fd = fd;
+        c.gen = fd_gen[fd];
+        c.watermark = last_sent;
+        c.is_close = true;
+        outq->push_back(std::move(c));
+      } else {
+        fd_gen[fd]++;
+      }
+      pthread_mutex_unlock(&resp_mu);
+      if (defer) return 0;
+      return real_close(fd);
+    }
     proxy_call(OP_CLOSE, fd, nullptr, 0);
+  }
+  // any real close invalidates pending held chunks for this fd NUMBER —
+  // the kernel may hand it to the next accepted connection immediately
+  // (e.g. an fd severed by a negative ack is closed by the app on this
+  // untracked path)
+  if (fd >= 0 && fd < kMaxFd) {
+    pthread_mutex_lock(&resp_mu);
+    fd_gen[fd]++;
+    pthread_mutex_unlock(&resp_mu);
   }
   return real_close(fd);
 }

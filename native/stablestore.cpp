@@ -101,6 +101,41 @@ int64_t ss_append(void* h, const void* buf, uint32_t len) {
   return static_cast<int64_t>(s->offsets.size()) - 1;
 }
 
+// Append a PRE-FRAMED batch of records (([u32 len][len bytes])* — the
+// same framing as the file itself): one write syscall for the whole
+// batch instead of two per record. Validates the framing before
+// touching the file; a partial write rolls back like ss_append.
+// Returns the number of records appended, or -1.
+int64_t ss_append_many(void* h, const void* buf, uint64_t len) {
+  auto* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
+  const char* p = static_cast<const char*>(buf);
+  uint64_t off = 0;
+  int64_t n = 0;
+  while (off + 4 <= len) {
+    uint32_t l;
+    memcpy(&l, p + off, 4);
+    if (off + 4 + l > len) return -1;
+    off += 4 + l;
+    n++;
+  }
+  if (off != len) return -1;
+  if (len && !write_exact(s->fd, buf, len)) {
+    if (ftruncate(s->fd, static_cast<off_t>(s->end)) != 0) { /* best effort */ }
+    lseek(s->fd, static_cast<off_t>(s->end), SEEK_SET);
+    return -1;
+  }
+  off = 0;
+  for (int64_t i = 0; i < n; i++) {
+    uint32_t l;
+    memcpy(&l, p + off, 4);
+    s->offsets.push_back(s->end + off);
+    off += 4 + l;
+  }
+  s->end += len;
+  return n;
+}
+
 int ss_sync(void* h) {
   auto* s = static_cast<Store*>(h);
   return fdatasync(s->fd) == 0 ? 0 : -1;

@@ -104,6 +104,9 @@ def _install(state_b: ReplicaState, r, index, term, cur_term, voted_term,
                 vote_rec_for=jnp.full((n_rec,), -1, i32),
                 epoch=epoch, bitmask_old=bm_old_u, bitmask_new=bm_new_u,
                 cid_state=cid,
+                cfg_src=-1,      # cache backed by the checkpoint below
+                cfg_src_term=0,
+
                 # the snapshot's config IS the donor's committed-config
                 # checkpoint (see Snapshot docstring); the wiped log holds
                 # no CONFIG entries, so the first derivation falls back
@@ -175,6 +178,8 @@ def genesis_row(donor_row: dict, *, group_mask: int, epoch: int,
         vote_rec_for=np.full(n_replicas, -1, i32),
         cid_state=i32(int(ConfigState.STABLE)),
         bitmask_old=mask, bitmask_new=mask, epoch=i32(epoch),
+        cfg_src=i32(-1),        # CONFIG entries were re-typed NOOP above
+        cfg_src_term=i32(0),
         ccfg_old=mask, ccfg_new=mask,
         ccfg_cid=i32(int(ConfigState.STABLE)), ccfg_epoch=i32(epoch),
     )

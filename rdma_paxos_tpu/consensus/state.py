@@ -80,6 +80,17 @@ class ReplicaState:
     bitmask_old: jax.Array  # u32 — member bitmask (old config)
     bitmask_new: jax.Array  # u32 — member bitmask (new/current config)
     epoch: jax.Array        # i32 — config epoch (bumped per change)
+    # gidx of the log entry backing the live config cache above, or -1
+    # when the cache came from the committed checkpoint / initial state.
+    # The step adopts newer CONFIG entries incrementally (from the
+    # appended batch / absorbed window) and re-derives by full-ring scan
+    # only when THIS entry is truncated or overwritten — see the CONFIG
+    # derivation block in consensus/step.py. cfg_src_term is the source
+    # entry's term: an absorbed window row at the same gidx but a
+    # different term is a DIFFERENT entry (a new leader's conflicting
+    # CONFIG) and must invalidate the cache.
+    cfg_src: jax.Array      # i32
+    cfg_src_term: jax.Array  # i32
     # Committed-config checkpoint — the newest CONFIG entry known
     # committed. The live config above is DERIVED each step as "newest
     # CONFIG entry retained in the log, else this checkpoint" (Raft's
@@ -119,6 +130,8 @@ def make_replica_state(
         bitmask_old=mask,
         bitmask_new=mask,
         epoch=i32(0),
+        cfg_src=i32(-1),
+        cfg_src_term=i32(0),
         ccfg_old=mask,
         ccfg_new=mask,
         ccfg_cid=i32(int(ConfigState.STABLE)),

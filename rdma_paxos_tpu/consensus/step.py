@@ -461,30 +461,106 @@ def replica_step(
         state.head)
 
     # ------------------------------------------------------------------
-    # CONFIG derivation — Raft's latest-configuration-in-the-log rule:
-    # the live config is the newest CONFIG entry retained in [head, end)
-    # (full-ring scan over the stamped M_GIDX column), else the committed
-    # checkpoint ccfg_*. CONFIG entries take effect from append/absorb
-    # time (poll_config_entries, dare_server.c:2133-2187), and because the
-    # config is RE-derived from the log every step, truncating an
-    # uncommitted CONFIG entry under the divergence rule automatically
-    # rolls the config back to the newest surviving one — the abandoned-
-    # config trap of an incremental epoch-gated adoption cannot occur.
-    # Runs BEFORE the commit scan (joint consensus needs the new quorum
-    # rules from append time).
+    # CONFIG derivation — Raft's latest-configuration-in-the-log rule,
+    # carried INCREMENTALLY: the live config (bitmask_old/new, cid_state,
+    # epoch) is cached state backed by the log entry at ``cfg_src``. Each
+    # step adopts any newer CONFIG arriving through the appended batch
+    # (O(B)) or the absorbed window (O(W)) — data already in registers —
+    # and only when the cached source entry is truncated or overwritten
+    # does a full-ring rescan run, under ``lax.cond`` (rare: divergence
+    # backoff / conflicting absorb). The rescan branch reproduces the
+    # original rule exactly — newest CONFIG retained in [head, end), else
+    # the committed checkpoint — so truncating an uncommitted CONFIG
+    # still rolls the config back (no abandoned-config trap). This
+    # removes two O(n_slots) scans from every stable step (they were the
+    # top device cost on the latency profile). CONFIG entries take
+    # effect from append/absorb time (poll_config_entries,
+    # dare_server.c:2133-2187). Runs BEFORE the commit scan (joint
+    # consensus needs the new quorum rules from append time).
     # ------------------------------------------------------------------
-    all_gidx = log3.meta[:, M_GIDX]                         # [n_slots]
-    live_cfg = ((log3.meta[:, M_TYPE] == int(EntryType.CONFIG))
+    wend_abs = m_wstart + m_wcount
+    # invalidation: source truncated away (divergence backoff or
+    # in-window conflict both leave end3 at/below it) …
+    stale_src = state.cfg_src >= end3
+    # … or overwritten by an absorbed window row that is no longer the
+    # same CONFIG entry
+    wp = jnp.clip(state.cfg_src - m_wstart, 0, W - 1)
+    # same gidx + type is NOT enough: a new leader's conflicting CONFIG
+    # at the same index is a different entry — the term disambiguates
+    same_entry = ((m_meta[wp, M_GIDX] == state.cfg_src)
+                  & (m_meta[wp, M_TYPE] == int(EntryType.CONFIG))
+                  & (m_meta[wp, M_TERM] == state.cfg_src_term))
+    replaced = (can_absorb & (state.cfg_src >= m_wstart)
+                & (state.cfg_src < wend_abs) & ~same_entry)
+    cfg_invalid = (state.cfg_src >= 0) & (stale_src | replaced)
+
+    def _cfg_rescan(_):
+        all_gidx = log3.meta[:, M_GIDX]
+        live = ((log3.meta[:, M_TYPE] == int(EntryType.CONFIG))
                 & (all_gidx >= head1) & (all_gidx < end3))
-    cfg_pos = _lex_argmax(live_cfg, [all_gidx])
-    cfg_words = log3.data[jnp.maximum(cfg_pos, 0)]          # payload
-    have_cfg = cfg_pos >= 0
-    bm_old2 = jnp.where(have_cfg, cfg_words[0].astype(jnp.uint32),
-                        state.ccfg_old)
-    bm_new2 = jnp.where(have_cfg, cfg_words[1].astype(jnp.uint32),
-                        state.ccfg_new)
-    cid2 = jnp.where(have_cfg, cfg_words[2], state.ccfg_cid)
-    epoch2 = jnp.where(have_cfg, cfg_words[3], state.ccfg_epoch)
+        pos = _lex_argmax(live, [all_gidx])
+        found = pos >= 0
+        psafe = jnp.maximum(pos, 0)
+        w = log3.data[psafe]
+        return (jnp.where(found, all_gidx[psafe], -1),
+                jnp.where(found, log3.meta[psafe, M_TERM], 0),
+                jnp.where(found, w[0].astype(jnp.uint32), state.ccfg_old),
+                jnp.where(found, w[1].astype(jnp.uint32), state.ccfg_new),
+                jnp.where(found, w[2], state.ccfg_cid),
+                jnp.where(found, w[3], state.ccfg_epoch))
+
+    def _cfg_keep(_):
+        return (state.cfg_src, state.cfg_src_term, state.bitmask_old,
+                state.bitmask_new, state.cid_state, state.epoch)
+
+    (base_src, base_sterm, base_old, base_new, base_cid,
+     base_epoch) = lax.cond(cfg_invalid, _cfg_rescan, _cfg_keep, None)
+
+    # newest CONFIG in the absorbed window (followers learn configs here)
+    w_offs = jnp.arange(W, dtype=i32)
+    w_gidx = m_wstart + w_offs
+    w_is_cfg = (can_absorb & (w_offs < m_wcount)
+                & (m_meta[:, M_TYPE] == int(EntryType.CONFIG))
+                & (m_meta[:, M_GIDX] == w_gidx)
+                & (w_gidx >= head1) & (w_gidx < end3))
+    wpos = _lex_argmax(w_is_cfg, [w_gidx])
+    w_words = m_data[jnp.maximum(wpos, 0)]
+    w_src = jnp.where(wpos >= 0, m_wstart + wpos, -1)
+
+    # newest CONFIG in the just-appended batch (the leader learns its
+    # own submissions here — its fan-out window may trail its end)
+    Bn = inp.batch_meta.shape[0]
+    b_offs = jnp.arange(Bn, dtype=i32)
+    b_is_cfg = ((b_offs < (end2 - end1))
+                & (inp.batch_meta[:, M_TYPE] == int(EntryType.CONFIG))
+                & ((end1 + b_offs) < end3))
+    bpos = _lex_argmax(b_is_cfg, [b_offs])
+    b_words = inp.batch_data[jnp.maximum(bpos, 0)]
+    b_src = jnp.where(bpos >= 0, end1 + bpos, -1)
+
+    # adopt the candidate with the largest (gidx, term) — an absorbed
+    # window row at the SAME gidx as the base but a newer term is a new
+    # leader's conflicting CONFIG and must win; ties/absences fall back
+    # to the base cache (index 0)
+    w_term = m_meta[jnp.maximum(wpos, 0), M_TERM]
+    cand_src = jnp.stack([base_src, w_src, b_src])
+    cand_sterm = jnp.stack([
+        base_sterm, jnp.where(wpos >= 0, w_term, 0),
+        jnp.where(bpos >= 0, new_term, 0)])
+    cand_old = jnp.stack([base_old, w_words[0].astype(jnp.uint32),
+                          b_words[0].astype(jnp.uint32)])
+    cand_new = jnp.stack([base_new, w_words[1].astype(jnp.uint32),
+                          b_words[1].astype(jnp.uint32)])
+    cand_cid = jnp.stack([base_cid, w_words[2], b_words[2]])
+    cand_epoch = jnp.stack([base_epoch, w_words[3], b_words[3]])
+    pick = _lex_argmax(cand_src >= -1, [cand_src, cand_sterm])
+    pick = jnp.maximum(pick, 0)
+    cfg_src2 = cand_src[pick]
+    cfg_src_term2 = cand_sterm[pick]
+    bm_old2 = cand_old[pick]
+    bm_new2 = cand_new[pick]
+    cid2 = cand_cid[pick]
+    epoch2 = cand_epoch[pick]
     in_new2 = _popcount_vec(bm_new2, R)
     in_old2 = _popcount_vec(bm_old2, R)
     maj_old2 = jnp.sum(in_old2) // 2 + 1
@@ -510,8 +586,9 @@ def replica_step(
     acks_for_me = jnp.where(heard & (g_acks[:, 1] == me), g_acks[:, 0], 0)
     acks_pad = jnp.zeros((R_PAD,), i32).at[:R].set(acks_for_me)
 
-    terms_win = log3.meta[
-        slot_of(state.commit + jnp.arange(W, dtype=i32), cfg.n_slots), M_TERM]
+    cwin_g = state.commit + jnp.arange(W, dtype=i32)
+    cwin_meta = log3.meta[slot_of(cwin_g, cfg.n_slots)]     # [W, META_W]
+    terms_win = cwin_meta[:, M_TERM]
     scanned = commit_scan(
         acks_pad, state.commit, new_term2, end3, terms_win,
         bm_old2, q_mask2, transit2, maj_old2, maj_q2,
@@ -547,20 +624,38 @@ def replica_step(
     hard = (end3 - head1) > (7 * cfg.n_slots) // 8
     head2 = jnp.where(i_lead2 & hard, jnp.maximum(head2, apply2), head2)
 
-    # committed-config checkpoint: the newest CONFIG entry now below
-    # commit can never be truncated (backoff floors at commit), so it
-    # becomes the fallback when the ring holds no live CONFIG entry
-    # (pruned past, or every newer CONFIG was truncated).
-    live_ccfg = live_cfg & (all_gidx < commit2)
-    ccpos = _lex_argmax(live_ccfg, [all_gidx])
-    ccw = log3.data[jnp.maximum(ccpos, 0)]
-    have_cc = ccpos >= 0
-    ccfg_old2 = jnp.where(have_cc, ccw[0].astype(jnp.uint32),
-                          state.ccfg_old)
-    ccfg_new2 = jnp.where(have_cc, ccw[1].astype(jnp.uint32),
-                          state.ccfg_new)
-    ccfg_cid2 = jnp.where(have_cc, ccw[2], state.ccfg_cid)
-    ccfg_epoch2 = jnp.where(have_cc, ccw[3], state.ccfg_epoch)
+    # committed-config checkpoint: a CONFIG entry below commit can never
+    # be truncated (backoff floors at commit), so it becomes the
+    # fallback when the ring holds no live CONFIG entry (pruned past, or
+    # every newer CONFIG was truncated). Incremental form: (a) promote
+    # the live cache once its source entry commits; (b) scan the
+    # commit-CROSSING window [state.commit, commit2) — bounded by W —
+    # for an older CONFIG committing while a newer uncommitted one is
+    # cached (two-configs-in-flight; the driver serializes changes so
+    # this is a churn-replay corner). Newest-wins by epoch (epochs are
+    # strictly increasing along the committed config order by
+    # construction — MembershipManager bumps per change, and elastic
+    # genesis re-types old-world CONFIGs to NOOP).
+    crossed = ((cwin_meta[:, M_TYPE] == int(EntryType.CONFIG))
+               & (cwin_meta[:, M_GIDX] == cwin_g)
+               & (cwin_g < commit2))
+    xpos = _lex_argmax(crossed, [cwin_g])
+    xw = log3.data[slot_of(state.commit + jnp.maximum(xpos, 0),
+                           cfg.n_slots)]
+    x_found = xpos >= 0
+    cc1_old = jnp.where(x_found & (xw[3] > state.ccfg_epoch),
+                        xw[0].astype(jnp.uint32), state.ccfg_old)
+    cc1_new = jnp.where(x_found & (xw[3] > state.ccfg_epoch),
+                        xw[1].astype(jnp.uint32), state.ccfg_new)
+    cc1_cid = jnp.where(x_found & (xw[3] > state.ccfg_epoch),
+                        xw[2], state.ccfg_cid)
+    cc1_epoch = jnp.where(x_found & (xw[3] > state.ccfg_epoch),
+                          xw[3], state.ccfg_epoch)
+    promote = (cfg_src2 >= 0) & (cfg_src2 < commit2) & (epoch2 > cc1_epoch)
+    ccfg_old2 = jnp.where(promote, bm_old2, cc1_old)
+    ccfg_new2 = jnp.where(promote, bm_new2, cc1_new)
+    ccfg_cid2 = jnp.where(promote, cid2, cc1_cid)
+    ccfg_epoch2 = jnp.where(promote, epoch2, cc1_epoch)
 
     new_state = ReplicaState(
         log=log3, term=new_term2, role=role2, leader_id=leader_id2,
@@ -568,7 +663,7 @@ def replica_step(
         vote_rec_term=vote_rec_term2, vote_rec_for=vote_rec_for2,
         head=head2, apply=apply2, commit=commit2, end=end3,
         cid_state=cid2, bitmask_old=bm_old2, bitmask_new=bm_new2,
-        epoch=epoch2,
+        epoch=epoch2, cfg_src=cfg_src2, cfg_src_term=cfg_src_term2,
         ccfg_old=ccfg_old2, ccfg_new=ccfg_new2, ccfg_cid=ccfg_cid2,
         ccfg_epoch=ccfg_epoch2,
     )

@@ -109,6 +109,13 @@ class ProxyServer:
                 "field (int32 M_CONN allows 0..127)")
         self.node_id = node_id
         self.on_event = on_event
+        # declared by the shim's HELLO (bit0 of its payload byte): the
+        # app executes SPECULATIVELY on not-yet-committed input, holding
+        # replies until commit (output commit). The driver needs this to
+        # know that failing an inflight event (deposition) leaves the
+        # app DIRTY — it consumed input that may never commit — and must
+        # be quarantined until rebuilt from the committed store.
+        self.spec_mode = False
         # namespaced start (elastic generations) so a restarted host's
         # fresh connection ids avoid ids its previous incarnation stamped
         # into carried-over log entries. The namespace is bounded (16
@@ -172,6 +179,8 @@ class ProxyServer:
                 if payload is None:
                     return
                 if op not in _OP_TO_ETYPE:       # HELLO / unknown
+                    if op == OP_HELLO and payload:
+                        self.spec_mode = bool(payload[0] & 1)
                     respond(seq, 0)
                     continue
                 if op == OP_CONNECT:
@@ -256,9 +265,21 @@ class ReplayEngine:
             except OSError:
                 pass
         s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        s.connect(self.addr)
+        # bind first so the local port is REGISTERED before the app can
+        # possibly observe the connection: a hot-polling app accepts and
+        # reports CONNECT to the driver concurrently with (even before)
+        # our connect() returning, and the driver must never misclassify
+        # our own replay connection as a client session
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        self.local_ports.add(port)
+        try:
+            s.connect(self.addr)
+        except OSError:
+            self.local_ports.discard(port)
+            s.close()
+            raise
         self.conns[conn_id] = s
-        self.local_ports.add(s.getsockname()[1])
         return s
 
     def apply(self, etype: int, conn_id: int, payload: bytes) -> None:

@@ -83,6 +83,9 @@ def _load() -> ctypes.CDLL:
     lib.ss_append.restype = ctypes.c_int64
     lib.ss_append.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                               ctypes.c_uint32]
+    lib.ss_append_many.restype = ctypes.c_int64
+    lib.ss_append_many.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                   ctypes.c_uint64]
     lib.ss_sync.restype = ctypes.c_int
     lib.ss_sync.argtypes = [ctypes.c_void_p]
     lib.ss_count.restype = ctypes.c_int64
@@ -168,6 +171,17 @@ class StableStore:
         if idx < 0:
             raise OSError("stable store append failed")
         return idx
+
+    def append_framed(self, blob: bytes) -> int:
+        """Append a PRE-FRAMED batch (([u32 len][bytes])*) — the zero-
+        copy hot path fed by SimCluster's vectorized window decode."""
+        if not blob:
+            return 0
+        n = self._lib.ss_append_many(self._h, blob, len(blob))
+        if n < 0:
+            raise OSError("stable store framed append failed")
+        return int(n)
+
 
     def sync(self) -> None:
         if self._lib.ss_sync(self._h) != 0:

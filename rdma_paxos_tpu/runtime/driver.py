@@ -36,7 +36,7 @@ from rdma_paxos_tpu.consensus.state import ConfigState, Role
 from rdma_paxos_tpu.proxy.proxy import PendingEvent, ProxyServer, ReplayEngine
 from rdma_paxos_tpu.proxy.stablestore import HardState, StableStore
 from rdma_paxos_tpu.runtime.sim import SimCluster
-from rdma_paxos_tpu.runtime.timers import ElectionTimer, Pacer
+from rdma_paxos_tpu.runtime.timers import ElectionTimer
 from rdma_paxos_tpu.utils.debug import ReplicaLog
 from rdma_paxos_tpu.utils.codec import fragment
 
@@ -56,8 +56,17 @@ class _ReplicaRuntime:
         self.log = ReplicaLog(log_path)
         self.proxy = (ProxyServer(sock_path, idx, on_event)
                       if sock_path else None)
+        self.app_port = app_port
         self.replay = (ReplayEngine("127.0.0.1", app_port)
                        if app_port else None)
+        # a SPECULATIVE app (shim HELLO flag) consumed input that was
+        # failed at deposition — its state may have diverged from the
+        # committed stream. While dirty: committed entries still persist
+        # to the store (the store is the source of truth), but nothing
+        # is replayed into the app and new client sessions are severed;
+        # the operator restarts the app and calls reset_app().
+        self.app_dirty = False
+        self.last_sync = 0.0      # cadenced store fdatasync bookkeeping
         self.store = StableStore(store_path) if store_path else None
         # durable (term, voted_term, voted_for) — persisted every step the
         # pair changes, restored by recover_replica (election safety
@@ -87,8 +96,10 @@ class ClusterDriver:
                  timeout_cfg: Optional[TimeoutConfig] = None,
                  group_size: Optional[int] = None,
                  mode: str = "sim", seed: int = 0,
-                 auto_evict: bool = False, fail_threshold: int = 100):
+                 auto_evict: bool = False, fail_threshold: int = 100,
+                 sync_period: float = 0.05):
         self.cfg = cfg
+        self.sync_period = sync_period
         self.R = n_replicas
         self.cluster = SimCluster(cfg, n_replicas, group_size, mode=mode)
         self.timeout_cfg = timeout_cfg or TimeoutConfig()
@@ -107,11 +118,16 @@ class ClusterDriver:
         # stepping thread over cluster.state): (replica, donor, done_event)
         self._recover_req: Optional[Tuple[int, Optional[int],
                                           threading.Event]] = None
+        # app-reset requests (mis-speculation quarantine exit), same
+        # poll-loop execution discipline: (replica, done_event)
+        self._reset_req: Optional[Tuple[int, threading.Event]] = None
         self._lock = threading.Lock()
         # per-replica queues of (etype, conn_id, fragment_bytes, seq)
         self._submitq: List[List[Tuple[int, int, bytes, int]]]
         self._submitq = [[] for _ in range(n_replicas)]
         self._leader_view = -1
+        # stores consume the vectorized frame stream from the decode
+        self.cluster.collect_frames = workdir is not None
         self.runtimes: List[_ReplicaRuntime] = []
         for r in range(n_replicas):
             sock = (os.path.join(workdir, f"proxy{r}.sock")
@@ -128,6 +144,12 @@ class ClusterDriver:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.loop_error: Optional[BaseException] = None
+        # event-driven stepping: link threads set this when work arrives
+        # so an idle loop wakes instantly instead of polling — on a
+        # shared-core host a free-running loop would steal the CPU the
+        # app itself needs (the reference's libev loop is fd-driven for
+        # the same reason, dare_server.c:1004-1125)
+        self._wake = threading.Event()
 
     # ------------------------------------------------------------------
     # shim event intake (called from proxy link threads)
@@ -154,6 +176,10 @@ class ClusterDriver:
                             and port in rt.replay.local_ports):
                         rt.passthrough_conns.add(conn_id)
                         return None
+                    if rt.app_dirty:
+                        # a dirty (mis-speculated) app must not serve
+                        # clients — not even stale local reads
+                        return -1
                     if self._leader_view != r:
                         return None
                     rt.replicated_conns.add(conn_id)
@@ -164,6 +190,14 @@ class ClusterDriver:
                     return None
                 elif conn_id not in rt.replicated_conns:
                     return None          # never-replicated local session
+                elif rt.app_dirty:
+                    # a surviving replicated session on a replica whose
+                    # app diverged (mis-speculation) must be severed
+                    # even if this replica regained leadership — its
+                    # replies would come from state that does not match
+                    # the committed stream
+                    rt.replicated_conns.discard(conn_id)
+                    return -1
                 elif self._leader_view != r:
                     # a REPLICATED session must never silently downgrade
                     # to unreplicated service after deposition: sever it
@@ -182,6 +216,7 @@ class ClusterDriver:
                     self._submitq[r].append((etype, conn_id, f,
                                              rt.submit_seq))
                 rt.inflight.append((ev, rt.submit_seq))
+                self._wake.set()
                 return ev
         return on_event
 
@@ -197,6 +232,14 @@ class ClusterDriver:
             r, donor, done = req
             try:
                 self._do_recover(r, donor)
+            finally:
+                done.set()
+        rreq = self._reset_req
+        if rreq is not None:
+            self._reset_req = None
+            r, done = rreq
+            try:
+                self._do_reset_app(r)
             finally:
                 done.set()
         with self._lock:
@@ -284,9 +327,19 @@ class ClusterDriver:
                     # clients time out the same way). Fragments already
                     # replicated may still commit later; seq-stamped acks
                     # make those late applies harmless no-ops.
+                    failed = len(rt.inflight)
                     while rt.inflight:
                         ev, _ = rt.inflight.popleft()
                         ev.release(-1)
+                    if (failed and rt.proxy is not None
+                            and rt.proxy.spec_mode and not rt.app_dirty):
+                        # a speculative app already EXECUTED those failed
+                        # inputs: its state may have diverged from the
+                        # committed stream — quarantine until rebuilt
+                        rt.app_dirty = True
+                        rt.log.info_wtime(
+                            "APP DIRTY: %d speculated events failed at "
+                            "deposition" % failed)
 
         self._failure_detector(res)
         self._drive_config_change()
@@ -401,11 +454,42 @@ class ClusterDriver:
         rebuilt by replaying the store. Executes inside the poll loop so
         it never races the stepping thread over cluster state."""
         done = threading.Event()
-        self._recover_req = (r, donor, done)
+        with self._lock:
+            if self._recover_req is not None:
+                raise RuntimeError("a recovery request is already pending")
+            self._recover_req = (r, donor, done)
+        self._wake.set()
         if self._thread is None or not self._thread.is_alive():
             self.step()
         elif not done.wait(timeout):
             raise TimeoutError("recovery did not run (loop stalled?)")
+
+    def reset_app(self, r: int, timeout: float = 60.0) -> None:
+        """Exit mis-speculation quarantine: the operator has restarted
+        replica ``r``'s app FRESH; rebuild its state by replaying r's own
+        committed store (complete — persistence continued while dirty)
+        and resume live replay. Executes inside the poll loop."""
+        done = threading.Event()
+        with self._lock:
+            if self._reset_req is not None:
+                raise RuntimeError("an app reset is already pending")
+            self._reset_req = (r, done)
+        self._wake.set()
+        if self._thread is None or not self._thread.is_alive():
+            self.step()
+        elif not done.wait(timeout):
+            raise TimeoutError("app reset did not run (loop stalled?)")
+
+    def _do_reset_app(self, r: int) -> None:
+        rt = self.runtimes[r]
+        if rt.replay is not None:
+            rt.replay.close()
+            rt.replay = ReplayEngine("127.0.0.1", rt.app_port)
+        if rt.store is not None and rt.replay is not None:
+            from rdma_paxos_tpu.proxy.proxy import replay_store_into
+            replay_store_into(rt.store, rt.replay, start=0)
+        rt.app_dirty = False
+        rt.log.info_wtime("APP RESET: rebuilt from committed store")
 
     def _do_recover(self, r: int, donor: Optional[int],
                     app_fresh: bool = True) -> None:
@@ -438,6 +522,9 @@ class ClusterDriver:
         self.cluster.applied[r] = snap.index
         rt_stream = self.cluster.replayed[r]
         rrt.replay_cursor = len(rt_stream)
+        # undrained frames predate the snapshot load: appending them to
+        # the freshly loaded store would duplicate history
+        self.cluster.frames[r] = []
         if rrt.store is not None and snap.store_blob:
             old_len = len(rrt.store)
             rrt.store.reset()
@@ -452,49 +539,93 @@ class ClusterDriver:
 
     def _apply_new_entries(self, r: int, rt: _ReplicaRuntime) -> None:
         stream = self.cluster.replayed[r]
-        progressed = rt.replay_cursor < len(stream)
-        releases = []
-        while rt.replay_cursor < len(stream):
-            etype, conn, req, payload = stream[rt.replay_cursor]
-            rt.replay_cursor += 1
-            if rt.store is not None:
-                rec = (bytes([etype]) + conn.to_bytes(4, "little")
-                       + payload)
-                rt.store.append(rec)
+        n = len(stream)
+        if rt.replay_cursor >= n:
+            return
+        new = stream[rt.replay_cursor:]
+        rt.replay_cursor = n
+        if rt.store is not None:
+            # frames were assembled vectorized during the window decode
+            # (SimCluster.collect_frames); one syscall appends the batch
+            blobs = self.cluster.frames[r]
+            if blobs:
+                self.cluster.frames[r] = []
+                for b in blobs:
+                    rt.store.append_framed(b)
+        # a dirty app's state diverged: keep persisting (the store stays
+        # the complete committed stream) but feed the app nothing until
+        # reset_app rebuilds it
+        replaying = rt.replay is not None and not rt.app_dirty
+        own_max = -1
+        run_conn, run_buf = -1, []
+
+        def flush_run():
+            nonlocal run_conn, run_buf
+            if run_conn >= 0 and run_buf:
+                rt.replay.apply(int(EntryType.SEND), run_conn,
+                                b"".join(run_buf))
+            run_conn, run_buf = -1, []
+
+        for etype, conn, req, payload in new:
             if conn_origin(conn) != r:
-                if rt.replay is not None:
+                if not replaying:
+                    continue
+                # coalesce consecutive same-connection SENDs (a client
+                # event fragments into a consecutive run): one loopback
+                # write per run — byte-stream identical for the app
+                if etype == int(EntryType.SEND):
+                    if conn != run_conn:
+                        flush_run()
+                        run_conn = conn
+                    run_buf.append(payload)
+                else:
+                    flush_run()
                     rt.replay.apply(etype, conn, payload)
             else:
-                # ack release by sequence: every own-origin entry carries
-                # the fragment seq in req_id, so commits are matched
-                # exactly even across leadership churn
-                with self._lock:
-                    while rt.inflight and rt.inflight[0][1] <= req:
-                        ev, _ = rt.inflight.popleft()
-                        releases.append(ev)
-        if progressed:
-            if rt.replay is not None:
-                rt.replay.drain_responses()
-            if rt.store is not None:
-                # persist BEFORE acking (persist_new_entries precedes
-                # apply/ack in the reference): a client ack implies the
-                # event reached this replica's stable store
+                own_max = req
+        if replaying:
+            flush_run()
+            rt.replay.drain_responses()
+        if rt.store is not None:
+            # The WRITE precedes the ack (store_record runs inside the
+            # reference's apply, before the proxy releases the client,
+            # db-interface.c:65-96) — but the reference never fsyncs per
+            # record: its durability contract is replication to a
+            # QUORUM'S MEMORY plus an OS-buffered store write. Matching
+            # that, fdatasync runs on a cadence (and at close/snapshot),
+            # not on the ack path — a per-batch fsync was a measurable
+            # share of the shared-core budget and bought durability the
+            # reference never promised.
+            now = time.monotonic()
+            if now - rt.last_sync > self.sync_period:
                 rt.store.sync()
-        for ev in releases:
-            ev.release(0)
+                rt.last_sync = now
+        if own_max >= 0:
+            # ack release by sequence: every own-origin entry carries
+            # the fragment seq in req_id (monotone in commit order), so
+            # commits are matched exactly even across leadership churn
+            releases = []
+            with self._lock:
+                while rt.inflight and rt.inflight[0][1] <= own_max:
+                    ev, _ = rt.inflight.popleft()
+                    releases.append(ev)
+            for ev in releases:
+                ev.release(0)
 
     # ------------------------------------------------------------------
     # lifecycle
     # ------------------------------------------------------------------
 
     def run(self, period: float = 0.0) -> None:
-        """Run the polling loop in a background thread, paced at
-        ``period`` (the hb_period cadence — each step carries the
-        heartbeat). Pacing is adaptive: while client work is pending or
-        blocked app threads await commit, the loop free-runs (the
-        reference's busy commit loop); it only sleeps when idle."""
+        """Run the polling loop in a background thread. While client work
+        is pending or blocked app threads await commit, the loop
+        free-runs (the reference's busy commit loop). When idle it
+        PARKS for up to ``period`` seconds (the hb_period cadence — each
+        step carries the heartbeat, so ``period`` must stay well under
+        the election timeout) and wakes INSTANTLY when a link thread
+        hands it an event — on a shared-core host, idle free-running
+        would steal the CPU the app itself needs."""
         def loop():
-            pacer = Pacer(period) if period else None
             while not self._stop.is_set():
                 try:
                     self.step()
@@ -516,13 +647,20 @@ class ClusterDriver:
                     busy = (any(self._submitq)
                             or any(len(q) for q in self.cluster.pending)
                             or any(rt.inflight for rt in self.runtimes))
-                if pacer is not None and not busy:
-                    pacer.wait()
+                if not busy and period:
+                    self._wake.wait(timeout=period)
+                self._wake.clear()
         self._thread = threading.Thread(target=loop, daemon=True)
         self._thread.start()
 
+    def prewarm(self) -> None:
+        """AOT-warm every step variant and burst tier so the first loaded
+        round never eats a multi-second JIT pause mid-serving."""
+        self.cluster.prewarm()
+
     def stop(self) -> None:
         self._stop.set()
+        self._wake.set()
         if self._thread is not None:
             self._thread.join(timeout=5)
         # release commit waiters that were already inflight at stop —

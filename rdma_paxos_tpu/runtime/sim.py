@@ -70,10 +70,15 @@ class SimCluster:
         else:
             self._step = self._build_step(elections=True)
         # all replicas' windows in ONE dispatch (the per-replica loop of
-        # fetch+slice dispatches dominated the host replay path)
+        # fetch+slice dispatches dominated the host replay path). The
+        # REPLAY window is wider than the protocol window: a K-step
+        # burst commits up to K*batch_slots entries at once, and each
+        # fetch dispatch costs host time — sweep in big gulps.
+        self._replay_W = min(cfg.n_slots // 2,
+                             max(4 * cfg.window_slots, 256))
         self._fetch_all = jax.jit(jax.vmap(
-            lambda log, start: fetch_window(log, start,
-                                            window_slots=cfg.window_slots)))
+            lambda log, start: fetch_window(
+                log, start, window_slots=self._replay_W)))
         # host bookkeeping
         self.applied = np.zeros(n_replicas, np.int64)   # host apply cursor
         self.peer_mask = np.ones((n_replicas, n_replicas), np.int32)
@@ -85,6 +90,13 @@ class SimCluster:
         # (type, conn_id, req_id, payload) per replica, in apply order
         self.replayed: List[List[Tuple[int, int, int, bytes]]] = [
             [] for _ in range(n_replicas)]
+        # store-ready framed blobs (([u32 len][etype][conn][payload])*)
+        # built VECTORIZED during the window decode — the driver hands
+        # them to StableStore.append_framed untouched. Only produced
+        # when a consumer opts in (collect_frames), so pure-sim tests
+        # don't accumulate them.
+        self.collect_frames = False
+        self.frames: List[List[bytes]] = [[] for _ in range(n_replicas)]
         # replicas whose log was force-pruned past their apply cursor
         # (force_log_pruning left them behind): replay stops — recycled
         # slots must never reach the app — until snapshot recovery
@@ -272,6 +284,33 @@ class SimCluster:
             self._STEP_CACHE[key] = cached
         return cached
 
+    def prewarm(self, tiers: Optional[Sequence[int]] = None) -> None:
+        """Compile every step variant and burst tier up front (on copies
+        of the live state — donation would otherwise consume it). A
+        first-use JIT pause of seconds mid-serving stalls the whole
+        commit pipeline; paying it before traffic starts keeps the
+        serving path pause-free."""
+        cfg, R, B = self.cfg, self.R, self.cfg.batch_slots
+        inp = StepInput(
+            batch_data=jnp.zeros((R, B, cfg.slot_words), jnp.int32),
+            batch_meta=jnp.zeros((R, B, META_W), jnp.int32),
+            batch_count=jnp.zeros((R,), jnp.int32),
+            timeout_fired=jnp.zeros((R,), jnp.int32),
+            peer_mask=jnp.asarray(self.peer_mask),
+            apply_done=jnp.zeros((R,), jnp.int32))
+        for elections in (True, False):
+            fn = self._build_step(elections=elections)
+            st = jax.tree.map(lambda x: x.copy(), self.state)
+            fn(st, inp)
+        pm = jnp.asarray(self.peer_mask)
+        ap = jnp.zeros((R,), jnp.int32)
+        for K in (tiers if tiers is not None else self.K_TIERS):
+            fn = self._burst_fn(K)
+            st = jax.tree.map(lambda x: x.copy(), self.state)
+            fn(st, jnp.zeros((K, R, B, cfg.slot_words), jnp.int32),
+               jnp.zeros((K, R, B, META_W), jnp.int32),
+               jnp.zeros((K, R), jnp.int32), pm, ap)
+
     def step(self, timeouts: Sequence[int] = ()) -> Dict[str, np.ndarray]:
         timeouts = list(timeouts)       # may be a one-shot iterable
         inp = self._build_inputs(timeouts)
@@ -307,7 +346,7 @@ class SimCluster:
         them to the proxy) — apply_committed_entries analog
         (dare_server.c:1815-1974). All replicas' windows ride ONE device
         dispatch per sweep."""
-        W = self.cfg.window_slots
+        W = self._replay_W
         # Force-pruned laggards: when the ring no longer PHYSICALLY holds
         # entry `applied` (a newer entry recycled its slot — possible
         # once forced pruning let appends run ahead of a wedged member's
@@ -329,20 +368,49 @@ class SimCluster:
             wd_all, wm_all = np.asarray(wd_all), np.asarray(wm_all)
             for r in todo:
                 commit = int(res["commit"][r])
-                n = min(commit - self.applied[r], W)
+                n = int(min(commit - self.applied[r], W))
                 wd, wm = wd_all[r], wm_all[r]
                 if n > 0 and int(wm[0, M_GIDX]) != self.applied[r]:
                     self.need_recovery.add(r)       # slot recycled
                     continue
-                for j in range(int(n)):
-                    t = int(wm[j, M_TYPE])
-                    if t in (int(EntryType.CONNECT), int(EntryType.SEND),
-                             int(EntryType.CLOSE)):
-                        ln = int(wm[j, M_LEN])
-                        payload = wd[j].astype("<i4").tobytes()[:ln]
-                        self.replayed[r].append(
-                            (t, int(wm[j, M_CONN]), int(wm[j, M_REQID]),
-                             payload))
+                # vectorized window decode: one contiguous byte view +
+                # one column read per field (the per-entry scalar
+                # conversions dominated the replay path at high rates)
+                types = wm[:n, M_TYPE]
+                client = ((types >= int(EntryType.CONNECT))
+                          & (types <= int(EntryType.CLOSE)))
+                idxs = np.nonzero(client)[0]
+                if idxs.size:
+                    conns = wm[:n, M_CONN]
+                    reqs = wm[:n, M_REQID]
+                    lens = wm[:n, M_LEN]
+                    raw = np.ascontiguousarray(
+                        wd[:n]).view(np.uint8).reshape(n, -1)
+                    row = raw.shape[1]
+                    buf = raw.tobytes()
+                    rep = self.replayed[r]
+                    for j in idxs:
+                        o = int(j) * row
+                        rep.append((int(types[j]), int(conns[j]),
+                                    int(reqs[j]),
+                                    buf[o:o + int(lens[j])]))
+                    if self.collect_frames:
+                        # frame = [u32 len][u8 etype][u32 conn][payload]
+                        # assembled for ALL client entries in two numpy
+                        # passes (fill + ragged masked gather) — zero
+                        # per-record Python on the store path
+                        k = idxs.size
+                        cl = lens[idxs].astype(np.uint32)
+                        mat = np.zeros((k, 9 + row), np.uint8)
+                        mat[:, 0:4] = (cl + 5).astype("<u4")[:, None] \
+                            .view(np.uint8)
+                        mat[:, 4] = types[idxs]
+                        mat[:, 5:9] = conns[idxs].astype("<i4")[:, None] \
+                            .view(np.uint8)
+                        mat[:, 9:] = raw[idxs]
+                        keep = (np.arange(9 + row, dtype=np.uint32)[None]
+                                < (9 + cl)[:, None])
+                        self.frames[r].append(mat[keep].tobytes())
                 self.applied[r] += n
 
     # ---------------- inspection ----------------

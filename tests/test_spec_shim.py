@@ -1,0 +1,204 @@
+"""Speculative execution + output commit (the shim's default discipline).
+
+The reference blocks the app thread inside ``read()`` until the event is
+committed (proxy.c:160) — fine at µs commit latency, but at a host-loop's
+ms-scale latency it caps a single-threaded app at one read-buffer per
+commit RTT. The TPU-native redesign (``native/interpose.cpp``): reads are
+forwarded asynchronously and the app executes immediately; its REPLIES are
+held until the commit frontier covers every input forwarded before the
+reply was produced. Externally the contract is unchanged — a client that
+holds a reply knows its request committed.
+
+These tests pin the two sides of that contract:
+
+* the happy path — replies only ever reflect committed input (follower
+  state equality, exactly-once), at full pipeline depth;
+* mis-speculation — a deposed leader whose app consumed input that never
+  committed is QUARANTINED (``app_dirty``): its clients are severed, new
+  sessions are refused, and ``ClusterDriver.reset_app`` rebuilds the
+  restarted app from the committed store, after which the diverged write
+  is provably gone.
+"""
+
+import os
+import socket
+import subprocess
+import time
+
+import pytest
+
+from rdma_paxos_tpu.config import LogConfig, TimeoutConfig
+from rdma_paxos_tpu.runtime.driver import ClusterDriver
+
+NATIVE = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+
+CFG = LogConfig(n_slots=256, slot_bytes=128, window_slots=32, batch_slots=16)
+PORTS = [7361, 7362, 7363]
+
+
+@pytest.fixture(scope="module", autouse=True)
+def build_native():
+    subprocess.run(["make", "-C", NATIVE], check=True, capture_output=True)
+
+
+def spawn_app(tmp_path, r, port):
+    env = dict(os.environ)
+    env["LD_PRELOAD"] = os.path.join(NATIVE, "interpose.so")
+    env["RP_PROXY_SOCK"] = os.path.join(str(tmp_path), f"proxy{r}.sock")
+    env.pop("RP_SPEC", None)          # default = speculative
+    return subprocess.Popen([os.path.join(NATIVE, "toyserver"), str(port)],
+                            env=env, stderr=subprocess.DEVNULL)
+
+
+class Client:
+    def __init__(self, port):
+        self.s = socket.create_connection(("127.0.0.1", port), timeout=10)
+        self.f = self.s.makefile("rb")
+
+    def cmd(self, line: str) -> bytes:
+        self.s.sendall(line.encode() + b"\n")
+        return self.f.readline().strip()
+
+    def send_only(self, line: str) -> None:
+        self.s.sendall(line.encode() + b"\n")
+
+    def close(self):
+        try:
+            self.s.close()
+        except OSError:
+            pass
+
+
+@pytest.fixture()
+def stack(tmp_path):
+    apps, driver = [], None
+    try:
+        driver = ClusterDriver(
+            CFG, 3, workdir=str(tmp_path), app_ports=PORTS,
+            timeout_cfg=TimeoutConfig(elec_timeout_low=0.3,
+                                      elec_timeout_high=0.6))
+        for r, port in enumerate(PORTS):
+            apps.append(spawn_app(tmp_path, r, port))
+        time.sleep(0.3)
+        driver.run(period=0.002)
+        deadline = time.time() + 60
+        while driver.leader() < 0 and time.time() < deadline:
+            time.sleep(0.05)
+        assert driver.leader() >= 0, "no leader elected"
+        yield driver, apps, tmp_path
+    finally:
+        if driver is not None:
+            driver.stop()
+        for a in apps:
+            a.kill()
+            a.wait()
+
+
+def wait_kv(port, key, want, timeout=15.0):
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        try:
+            c = Client(port)
+            last = c.cmd(f"GET {key}")
+            c.close()
+            if last == want:
+                return last
+        except OSError:
+            pass
+        time.sleep(0.1)
+    return last
+
+
+def test_spec_mode_declared_and_replies_imply_commit(stack):
+    driver, _apps, _tmp = stack
+    lead = driver.leader()
+    c = Client(PORTS[lead])
+    # a deep pipeline of writes — the app executes speculatively, but
+    # every reply we READ is an output-commit guarantee
+    for i in range(40):
+        assert c.cmd(f"SET k{i} v{i}") == b"+OK"
+    c.close()
+    # the shim declared itself speculative via HELLO
+    assert driver.runtimes[lead].proxy.spec_mode
+    # reply received => committed => must reach every follower
+    for r in range(3):
+        if r == lead:
+            continue
+        assert wait_kv(PORTS[r], "k39", b"v39") == b"v39", f"replica {r}"
+
+
+def test_misspeculation_quarantine_and_reset(stack):
+    driver, apps, tmp_path = stack
+    lead = driver.leader()
+
+    c = Client(PORTS[lead])
+    assert c.cmd("SET committed yes") == b"+OK"
+    for r in range(3):
+        assert wait_kv(PORTS[r], "committed", b"yes") == b"yes"
+
+    # isolate the leader, then feed it input that can never commit; the
+    # speculative app EXECUTES it (that is the point of speculation)
+    driver.cluster.partition([[lead], [r for r in range(3) if r != lead]])
+    c.send_only("SET poison bad")
+
+    # the majority side elects a new leader
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        nl = driver.leader()
+        if nl >= 0 and nl != lead:
+            break
+        time.sleep(0.05)
+    assert driver.leader() != lead, "no failover"
+
+    # heal: the old leader hears the higher term, steps down, and its
+    # un-committable inflight input marks the app dirty
+    driver.cluster.heal()
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if driver.runtimes[lead].app_dirty:
+            break
+        time.sleep(0.05)
+    assert driver.runtimes[lead].app_dirty, "mis-speculation not flagged"
+
+    # the poisoned client was severed (held reply dropped, never sent)
+    c.s.settimeout(5)
+    try:
+        data = c.s.recv(64)
+    except OSError:
+        data = b""
+    assert data == b"", "client of a mis-speculated event must be severed"
+    c.close()
+
+    # a dirty app refuses NEW sessions too (no stale/diverged reads)
+    s = socket.create_connection(("127.0.0.1", PORTS[lead]), timeout=5)
+    s.settimeout(5)
+    try:
+        s.sendall(b"GET committed\n")
+        refused = s.recv(64) == b""
+    except OSError:
+        refused = True
+    s.close()
+    assert refused, "dirty app served a session"
+
+    # operator path: restart the app fresh, rebuild from committed store
+    apps[lead].kill()
+    apps[lead].wait()
+    apps[lead] = spawn_app(tmp_path, lead, PORTS[lead])
+    time.sleep(0.3)
+    driver.reset_app(lead)
+    assert not driver.runtimes[lead].app_dirty
+
+    # committed state survived; the diverged write is GONE
+    assert wait_kv(PORTS[lead], "committed", b"yes") == b"yes"
+    cchk = Client(PORTS[lead])
+    assert cchk.cmd("GET poison") == b"-"
+    cchk.close()
+
+    # and the reset app resumes live replication from the new leader
+    nl = driver.leader()
+    cw = Client(PORTS[nl])
+    assert cw.cmd("SET after reset-ok") == b"+OK"
+    cw.close()
+    assert wait_kv(PORTS[lead], "after", b"reset-ok") == b"reset-ok"
