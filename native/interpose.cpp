@@ -378,8 +378,11 @@ void proxy_cast(uint8_t op, int32_t fd, const void* data, uint32_t len) {
 }
 
 // Hold (or pass) app output on a tracked fd. Returns the byte count the
-// app should believe it wrote.
-ssize_t hold_output(int fd, const void* buf, size_t count) {
+// app should believe it wrote. `flags` carries the caller's send()
+// flags for the pass-through path (MSG_NOSIGNAL always added: the app
+// may rely on it rather than ignoring SIGPIPE process-wide; a tracked
+// fd is always a socket, so real_send is valid even for write()).
+ssize_t hold_output(int fd, const void* buf, size_t count, int flags) {
   pthread_mutex_lock(&resp_mu);
   if (severed[fd]) {
     pthread_mutex_unlock(&resp_mu);
@@ -391,7 +394,7 @@ ssize_t hold_output(int fd, const void* buf, size_t count) {
   // cannot overtake a held one, so write straight through
   if ((!outq || outq->empty()) && frontier >= last_sent && !flushing) {
     pthread_mutex_unlock(&resp_mu);
-    return real_write(fd, buf, count);
+    return real_send(fd, buf, count, flags | MSG_NOSIGNAL);
   }
   while (outq_bytes > kOutCap && !driver_dead)
     pthread_cond_wait(&resp_cv, &resp_mu);  // backpressure the app
@@ -534,7 +537,7 @@ ssize_t read(int fd, void* buf, size_t count) {
 ssize_t write(int fd, const void* buf, size_t count) {
   if (!real_write) resolve();
   if (spec_mode && proxy_fd >= 0 && fd >= 0 && fd < kMaxFd && tracked[fd])
-    return hold_output(fd, buf, count);
+    return hold_output(fd, buf, count, 0);
   return real_write(fd, buf, count);
 }
 
@@ -542,7 +545,7 @@ ssize_t send(int sockfd, const void* buf, size_t len, int flags) {
   if (!real_send) resolve();
   if (spec_mode && proxy_fd >= 0 && sockfd >= 0 && sockfd < kMaxFd &&
       tracked[sockfd])
-    return hold_output(sockfd, buf, len);
+    return hold_output(sockfd, buf, len, flags);
   return real_send(sockfd, buf, len, flags);
 }
 
@@ -552,7 +555,7 @@ ssize_t writev(int fd, const struct iovec* iov, int iovcnt) {
     ssize_t total = 0;
     for (int i = 0; i < iovcnt; i++) {
       if (iov[i].iov_len == 0) continue;
-      ssize_t r = hold_output(fd, iov[i].iov_base, iov[i].iov_len);
+      ssize_t r = hold_output(fd, iov[i].iov_base, iov[i].iov_len, 0);
       if (r < 0) return total > 0 ? total : r;
       total += r;
     }
@@ -569,7 +572,7 @@ ssize_t sendmsg(int sockfd, const struct msghdr* msg, int flags) {
     for (size_t i = 0; i < msg->msg_iovlen; i++) {
       if (msg->msg_iov[i].iov_len == 0) continue;
       ssize_t r = hold_output(sockfd, msg->msg_iov[i].iov_base,
-                              msg->msg_iov[i].iov_len);
+                              msg->msg_iov[i].iov_len, flags);
       if (r < 0) return total > 0 ? total : r;
       total += r;
     }

@@ -1,0 +1,74 @@
+/* miniev — a minimal, self-contained implementation of the libevent-1.4
+ * compatibility API, exactly the surface memcached 1.4.21 consumes
+ * (event_init / event_set / event_base_set / event_add / event_del /
+ * event_base_loop / event_get_version + the evtimer_* macros).
+ *
+ * Why it exists: this image ships libevent 2.1 RUNTIME libraries but no
+ * development headers, and `struct event` is embedded BY VALUE in
+ * memcached's conn struct — faking libevent's internal struct layout in
+ * a hand-written header against the real .so would be ABI roulette.
+ * Instead the whole event loop is reimplemented (~200 lines over epoll)
+ * against THIS header, and memcached links the static libevent.a built
+ * from it, so header and implementation can never disagree.
+ *
+ * Model: one event_base per thread (memcached's usage — the base is
+ * single-threaded by design, like libevent's unlocked 1.4 default).
+ * fd events via epoll (EV_PERSIST honored; non-persistent events are
+ * auto-deleted before their callback fires, matching libevent). Timer
+ * events (fd == -1) in a simple linked list — memcached arms one clock
+ * timer per process.
+ */
+#ifndef MINIEV_EVENT_H
+#define MINIEV_EVENT_H
+
+#include <sys/time.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define EV_TIMEOUT 0x01
+#define EV_READ    0x02
+#define EV_WRITE   0x04
+#define EV_SIGNAL  0x08
+#define EV_PERSIST 0x10
+
+struct event_base;
+
+struct event {
+    struct event_base *ev_base;
+    int ev_fd;
+    short ev_events;               /* EV_* flags requested */
+    void (*ev_callback)(int, short, void *);
+    void *ev_arg;
+    /* internal */
+    int ev_added;
+    struct timeval ev_deadline;    /* absolute, for timer events */
+    struct event *ev_next;         /* base's registration list */
+};
+
+struct event_base *event_base_new(void);
+struct event_base *event_init(void);     /* new base, set as current */
+void event_base_free(struct event_base *);
+
+void event_set(struct event *, int fd, short events,
+               void (*cb)(int, short, void *), void *arg);
+int event_base_set(struct event_base *, struct event *);
+int event_add(struct event *, const struct timeval *timeout);
+int event_del(struct event *);
+int event_base_loop(struct event_base *, int flags);
+int event_base_loopexit(struct event_base *, const struct timeval *);
+const char *event_get_version(void);
+
+#define evtimer_set(ev, cb, arg) event_set(ev, -1, 0, cb, arg)
+#define evtimer_add(ev, tv)      event_add(ev, tv)
+#define evtimer_del(ev)          event_del(ev)
+
+#define EVLOOP_ONCE     0x01
+#define EVLOOP_NONBLOCK 0x02
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* MINIEV_EVENT_H */

@@ -1,0 +1,179 @@
+/* miniev — implementation. See event.h for scope and rationale. */
+
+#include "event.h"
+
+#include <errno.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <unistd.h>
+
+struct event_base {
+    int epfd;
+    struct event *events;          /* singly-linked registration list */
+    int nadded;
+    int loopexit;
+};
+
+static __thread struct event_base *current_base;
+
+struct event_base *event_base_new(void) {
+    struct event_base *b = calloc(1, sizeof *b);
+    if (!b) return NULL;
+    b->epfd = epoll_create1(0);
+    if (b->epfd < 0) { free(b); return NULL; }
+    return b;
+}
+
+struct event_base *event_init(void) {
+    current_base = event_base_new();
+    return current_base;
+}
+
+void event_base_free(struct event_base *b) {
+    if (!b) return;
+    close(b->epfd);
+    free(b);
+}
+
+void event_set(struct event *ev, int fd, short events,
+               void (*cb)(int, short, void *), void *arg) {
+    ev->ev_base = current_base;
+    ev->ev_fd = fd;
+    ev->ev_events = events;
+    ev->ev_callback = cb;
+    ev->ev_arg = arg;
+    ev->ev_added = 0;
+    ev->ev_next = NULL;
+}
+
+int event_base_set(struct event_base *b, struct event *ev) {
+    ev->ev_base = b;
+    return 0;
+}
+
+static void list_remove(struct event_base *b, struct event *ev) {
+    struct event **p = &b->events;
+    while (*p && *p != ev) p = &(*p)->ev_next;
+    if (*p) *p = ev->ev_next;
+    ev->ev_next = NULL;
+}
+
+int event_add(struct event *ev, const struct timeval *tv) {
+    struct event_base *b = ev->ev_base;
+    if (!b) return -1;
+    if (ev->ev_added) event_del(ev);
+    if (ev->ev_fd >= 0) {
+        struct epoll_event ee;
+        memset(&ee, 0, sizeof ee);
+        ee.data.ptr = ev;
+        if (ev->ev_events & EV_READ) ee.events |= EPOLLIN;
+        if (ev->ev_events & EV_WRITE) ee.events |= EPOLLOUT;
+        if (epoll_ctl(b->epfd, EPOLL_CTL_ADD, ev->ev_fd, &ee) != 0)
+            return -1;
+    }
+    if (tv) {
+        struct timeval now;
+        gettimeofday(&now, NULL);
+        timeradd(&now, tv, &ev->ev_deadline);
+    } else {
+        timerclear(&ev->ev_deadline);
+    }
+    ev->ev_next = b->events;
+    b->events = ev;
+    ev->ev_added = 1;
+    b->nadded++;
+    return 0;
+}
+
+int event_del(struct event *ev) {
+    struct event_base *b = ev->ev_base;
+    if (!b || !ev->ev_added) return 0;
+    if (ev->ev_fd >= 0)
+        epoll_ctl(b->epfd, EPOLL_CTL_DEL, ev->ev_fd, NULL);
+    list_remove(b, ev);
+    ev->ev_added = 0;
+    b->nadded--;
+    return 0;
+}
+
+/* ms until the earliest armed deadline, or -1 for none */
+static int next_timeout_ms(struct event_base *b) {
+    struct timeval now, d;
+    int best = -1;
+    gettimeofday(&now, NULL);
+    for (struct event *e = b->events; e; e = e->ev_next) {
+        if (!timerisset(&e->ev_deadline)) continue;
+        int ms;
+        if (timercmp(&e->ev_deadline, &now, <=)) {
+            ms = 0;
+        } else {
+            timersub(&e->ev_deadline, &now, &d);
+            ms = (int)(d.tv_sec * 1000 + d.tv_usec / 1000 + 1);
+        }
+        if (best < 0 || ms < best) best = ms;
+    }
+    return best;
+}
+
+static void fire_expired_timers(struct event_base *b) {
+    struct timeval now;
+    gettimeofday(&now, NULL);
+    /* re-walk after each callback: callbacks may add/del events */
+    int fired;
+    do {
+        fired = 0;
+        for (struct event *e = b->events; e; e = e->ev_next) {
+            if (!timerisset(&e->ev_deadline)) continue;
+            if (timercmp(&e->ev_deadline, &now, <=)) {
+                event_del(e);
+                e->ev_callback(e->ev_fd, EV_TIMEOUT, e->ev_arg);
+                fired = 1;
+                break;
+            }
+        }
+    } while (fired);
+}
+
+int event_base_loop(struct event_base *b, int flags) {
+    b->loopexit = 0;
+    do {
+        if (b->nadded == 0) return 1;      /* nothing to wait for */
+        int ms = next_timeout_ms(b);
+        if (flags & EVLOOP_NONBLOCK) ms = 0;
+        struct epoll_event out[64];
+        int n = epoll_wait(b->epfd, out, 64, ms);
+        if (n < 0) {
+            if (errno == EINTR) continue;
+            return -1;
+        }
+        for (int i = 0; i < n; i++) {
+            struct event *e = out[i].data.ptr;
+            if (!e->ev_added)
+                continue;   /* deleted by an earlier callback this batch */
+            short what = 0;
+            if (out[i].events & (EPOLLHUP | EPOLLERR))
+                what |= (short)(e->ev_events & (EV_READ | EV_WRITE));
+            if (out[i].events & EPOLLIN) what |= EV_READ;
+            if (out[i].events & EPOLLOUT) what |= EV_WRITE;
+            what &= e->ev_events;
+            if (!what)
+                continue;
+            if (!(e->ev_events & EV_PERSIST))
+                event_del(e);
+            e->ev_callback(e->ev_fd, what, e->ev_arg);
+        }
+        fire_expired_timers(b);
+    } while (!b->loopexit && !(flags & (EVLOOP_ONCE | EVLOOP_NONBLOCK)));
+    return 0;
+}
+
+int event_base_loopexit(struct event_base *b, const struct timeval *tv) {
+    (void)tv;
+    b->loopexit = 1;
+    return 0;
+}
+
+const char *event_get_version(void) {
+    return "miniev-1.4-compat 0.1";
+}

@@ -471,9 +471,15 @@ def replica_step(
     # backoff / conflicting absorb). The rescan branch reproduces the
     # original rule exactly — newest CONFIG retained in [head, end), else
     # the committed checkpoint — so truncating an uncommitted CONFIG
-    # still rolls the config back (no abandoned-config trap). This
-    # removes two O(n_slots) scans from every stable step (they were the
-    # top device cost on the latency profile). CONFIG entries take
+    # still rolls the config back (no abandoned-config trap).
+    #
+    # Cost honesty: under ``shard_map`` (the real multi-chip path) the
+    # predicate is a per-device scalar and the rescan truly only runs on
+    # invalidation; under ``vmap`` (single-chip simulation) a batched-
+    # predicate cond lowers to select_n and BOTH branches execute, so
+    # the sim still pays one full-ring scan per step — the same cost as
+    # the pre-incremental code, no worse. The committed-checkpoint scan
+    # below was removed outright on every path. CONFIG entries take
     # effect from append/absorb time (poll_config_entries,
     # dare_server.c:2133-2187). Runs BEFORE the commit scan (joint
     # consensus needs the new quorum rules from append time).

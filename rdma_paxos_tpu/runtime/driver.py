@@ -97,9 +97,20 @@ class ClusterDriver:
                  group_size: Optional[int] = None,
                  mode: str = "sim", seed: int = 0,
                  auto_evict: bool = False, fail_threshold: int = 100,
-                 sync_period: float = 0.05):
+                 sync_period: float = 0.05, step_down_steps: int = 50):
         self.cfg = cfg
         self.sync_period = sync_period
+        # lost-majority step-down (the reference leader SUICIDES after
+        # failing to reach a majority, dare_server.c:1213-1217): a
+        # leader whose leadership_verified stays 0 for this many
+        # consecutive steps stops SERVING — inflight commits are failed
+        # and replicated sessions severed/refused — so a minority-side
+        # leader's clients retry against the majority instead of
+        # hanging. Unlike the reference's process exit, service resumes
+        # if the leader re-verifies (majority restored with no rival).
+        self.step_down_steps = step_down_steps
+        self.unverified = np.zeros(n_replicas, np.int64)
+        self.stepped_down: set = set()
         self.R = n_replicas
         self.cluster = SimCluster(cfg, n_replicas, group_size, mode=mode)
         self.timeout_cfg = timeout_cfg or TimeoutConfig()
@@ -182,6 +193,11 @@ class ClusterDriver:
                         return -1
                     if self._leader_view != r:
                         return None
+                    if r in self.stepped_down:
+                        # a stepped-down (majority-less) leader accepts
+                        # no new sessions at all — the reference's
+                        # suicided leader serves nothing
+                        return -1
                     rt.replicated_conns.add(conn_id)
                     payload = b""
                 elif conn_id in rt.passthrough_conns:
@@ -190,6 +206,11 @@ class ClusterDriver:
                     return None
                 elif conn_id not in rt.replicated_conns:
                     return None          # never-replicated local session
+                elif r in self.stepped_down:
+                    # lost-majority step-down: refuse replicated service
+                    # (a commit wait could never complete)
+                    rt.replicated_conns.discard(conn_id)
+                    return -1
                 elif rt.app_dirty:
                     # a surviving replicated session on a replica whose
                     # app diverged (mis-speculation) must be severed
@@ -327,20 +348,9 @@ class ClusterDriver:
                     # clients time out the same way). Fragments already
                     # replicated may still commit later; seq-stamped acks
                     # make those late applies harmless no-ops.
-                    failed = len(rt.inflight)
-                    while rt.inflight:
-                        ev, _ = rt.inflight.popleft()
-                        ev.release(-1)
-                    if (failed and rt.proxy is not None
-                            and rt.proxy.spec_mode and not rt.app_dirty):
-                        # a speculative app already EXECUTED those failed
-                        # inputs: its state may have diverged from the
-                        # committed stream — quarantine until rebuilt
-                        rt.app_dirty = True
-                        rt.log.info_wtime(
-                            "APP DIRTY: %d speculated events failed at "
-                            "deposition" % failed)
+                    self._fail_inflight_locked(rt, "deposition")
 
+        self._step_down_detector(res)
         self._failure_detector(res)
         self._drive_config_change()
         # a replica force-pruned past its apply cursor (wedged app now
@@ -369,6 +379,54 @@ class ClusterDriver:
     # failure detection + eviction (push-detection analog: WC failures
     # -> fail_count >= threshold -> CONFIG removal, dare_server.c:1189)
     # ------------------------------------------------------------------
+
+    def _fail_inflight_locked(self, rt: _ReplicaRuntime,
+                              site: str) -> None:
+        """Fail every blocked commit waiter (caller holds the lock). A
+        SPECULATIVE app already executed the inputs being failed, so its
+        state may have diverged from the committed stream — quarantine
+        it (app_dirty) until rebuilt via reset_app."""
+        if (rt.inflight and rt.proxy is not None
+                and rt.proxy.spec_mode and not rt.app_dirty):
+            rt.app_dirty = True
+            rt.log.info_wtime(
+                "APP DIRTY: %d speculated events failed at %s"
+                % (len(rt.inflight), site))
+        while rt.inflight:
+            ev, _ = rt.inflight.popleft()
+            ev.release(-1)
+
+    def _step_down_detector(self, res) -> None:
+        """Lost-majority step-down (dare_server.c:1213-1217 analog): a
+        leader that cannot verify its authority against a majority for
+        ``step_down_steps`` consecutive steps stops serving — blocked
+        commit waiters fail (clients retry elsewhere) and replicated
+        sessions are refused until it re-verifies or is deposed."""
+        for r in range(self.R):
+            is_lead = res["role"][r] == int(Role.LEADER)
+            if is_lead and not res["leadership_verified"][r]:
+                self.unverified[r] += 1
+            else:
+                self.unverified[r] = 0
+                if r in self.stepped_down:
+                    self.stepped_down.discard(r)
+                    self.runtimes[r].log.info_wtime(
+                        "REJOINED: leadership re-verified or deposed")
+            if (is_lead and r not in self.stepped_down
+                    and self.unverified[r] >= self.step_down_steps):
+                self.stepped_down.add(r)
+                rt = self.runtimes[r]
+                rt.log.info_wtime(
+                    "[T%d] LOST MAJORITY: stepping down after %d "
+                    "unverified steps" % (int(res["term"][r]),
+                                          int(self.unverified[r])))
+                # replicated_conns is deliberately NOT cleared: removing
+                # a session from the set would downgrade its next event
+                # to unreplicated pass-through (acked lost write); the
+                # stepped_down branch in on_event severs each surviving
+                # session on its next event instead.
+                with self._lock:
+                    self._fail_inflight_locked(rt, "step-down")
 
     def _failure_detector(self, res) -> None:
         lead = self._leader_view

@@ -79,6 +79,12 @@ class NodeDaemon:
                               # cross-incarnation req compares never happen
         self.applied = int(genesis["apply"]) if genesis is not None else 0
         self.needs_recovery = False   # force-pruned past our apply cursor
+        # mis-speculation quarantine (same contract as ClusterDriver): a
+        # SPECULATIVE app (shim HELLO flag) that consumed inputs failed
+        # at deposition has diverged from the committed stream — the
+        # store keeps persisting, the app gets nothing until rebuilt
+        # (reset_app / generation bootstrap_from_store)
+        self.app_dirty = False
         self.replicated_conns: set = set()
         self.passthrough_conns: set = set()
         self.sock_path = os.path.join(workdir, f"proxy{self.me}.sock")
@@ -117,6 +123,10 @@ class NodeDaemon:
                         and port in self.replay.local_ports):
                     self.passthrough_conns.add(conn_id)
                     return None
+                if self.app_dirty:
+                    # a dirty (mis-speculated) app serves nothing —
+                    # not even stale local reads
+                    return -1
                 if not self._is_leader:
                     return None
                 self.replicated_conns.add(conn_id)
@@ -127,6 +137,9 @@ class NodeDaemon:
                 return None
             elif conn_id not in self.replicated_conns:
                 return None
+            elif self.app_dirty:
+                self.replicated_conns.discard(conn_id)
+                return -1
             elif not self._is_leader:
                 if etype == int(EntryType.CLOSE):
                     self.replicated_conns.discard(conn_id)
@@ -213,7 +226,9 @@ class NodeDaemon:
                         while self.inflight and self.inflight[0][1] <= req:
                             ev, _ = self.inflight.popleft()
                             releases.append(ev)
-                elif self.replay is not None:
+                elif self.replay is not None and not self.app_dirty:
+                    # dirty app: persist only — replay resumes after
+                    # the app is rebuilt from the committed store
                     self.replay.apply(etype, conn, payload)
         self.applied += max(n, 0)
         if progressed:
@@ -227,6 +242,15 @@ class NodeDaemon:
             ev.release(0)
         if not self._is_leader:
             with self._lock:
+                if (self.inflight and self.proxy.spec_mode
+                        and not self.app_dirty):
+                    # a speculative app already EXECUTED the inputs being
+                    # failed: quarantine until rebuilt (reset_app or the
+                    # next generation's bootstrap_from_store)
+                    self.app_dirty = True
+                    self.log.info_wtime(
+                        "APP DIRTY: %d speculated events failed at "
+                        "deposition" % len(self.inflight))
                 while self.inflight:
                     ev, _ = self.inflight.popleft()
                     ev.release(-1)
@@ -240,6 +264,19 @@ class NodeDaemon:
         the app, this fills it."""
         from rdma_paxos_tpu.proxy.proxy import replay_store_into
         replay_store_into(self.store, self.replay)
+        self.app_dirty = False
+
+    def reset_app(self, app_port: Optional[int] = None) -> None:
+        """Exit mis-speculation quarantine: the supervisor restarted the
+        app FRESH; rebuild it from this host's own committed store and
+        resume live replay."""
+        if self.replay is not None:
+            self.replay.close()
+            self.replay = ReplayEngine(
+                "127.0.0.1",
+                app_port if app_port is not None else self.replay.addr[1])
+        self.bootstrap_from_store()
+        self.log.info_wtime("APP RESET: rebuilt from committed store")
 
     def dump_row(self) -> dict:
         """THIS replica's full consensus state row (host numpy) — what

@@ -1,0 +1,205 @@
+"""Full-stack e2e on PRISTINE SSDB — the reference's third proof app
+(``/root/reference/apps/ssdb/mk``; leveldb-backed NoSQL server),
+replicated with zero source modifications.
+
+SSDB exercises yet another app shape: a C++ epoll event-loop server with
+a PERSISTENT on-disk state machine (leveldb) and a chatty length-prefixed
+native protocol. Its inbound path is plain ``accept()`` + ``read()``
+(src/net/link.cpp:186,222) — exactly the hooked surface. The offline
+build recipe (apps/ssdb/mk) needs two build-environment accommodations
+(no autoconf in the image; jemalloc stubbed to libc malloc) but zero app
+changes.
+
+Covers: replication to followers, bulk equality, non-idempotent incr
+applied exactly once.
+"""
+
+import os
+import socket
+import subprocess
+import time
+
+import pytest
+
+from rdma_paxos_tpu.config import LogConfig, TimeoutConfig
+from rdma_paxos_tpu.runtime.driver import ClusterDriver
+
+NATIVE = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+MK = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "apps", "ssdb", "mk")
+BUILD = "/tmp/rp_ssdb_build"
+SRC = os.path.join(BUILD, "ssdb-master")
+BIN = os.path.join(SRC, "ssdb-server")
+
+CFG = LogConfig(n_slots=512, slot_bytes=256, window_slots=64,
+                batch_slots=32)
+PORTS = [7411, 7412, 7413]
+
+
+def ensure_ssdb() -> str:
+    if os.path.exists(BIN):
+        return BIN
+    r = subprocess.run(["sh", MK, BUILD], capture_output=True,
+                       timeout=1200)
+    if r.returncode != 0 or not os.path.exists(BIN):
+        pytest.skip("ssdb build unavailable: %s"
+                    % r.stderr.decode()[-200:])
+    return BIN
+
+
+@pytest.fixture(scope="module", autouse=True)
+def build_native():
+    subprocess.run(["make", "-C", NATIVE], check=True, capture_output=True)
+    ensure_ssdb()
+
+
+def write_conf(workdir: str, r: int, port: int) -> str:
+    var = os.path.join(workdir, f"ssdb_var{r}")
+    os.makedirs(var, exist_ok=True)
+    path = os.path.join(workdir, f"ssdb{r}.conf")
+    with open(os.path.join(SRC, "ssdb.conf")) as f:
+        conf = f.read()
+    conf = conf.replace("port: 8888", f"port: {port}")
+    conf = conf.replace("work_dir = ./var", f"work_dir = {var}")
+    conf = conf.replace("pidfile = ./var/ssdb.pid",
+                        f"pidfile = {var}/ssdb.pid")
+    with open(path, "w") as f:
+        f.write(conf)
+    return path
+
+
+class SsdbClient:
+    """Minimal SSDB native-protocol client (len\\ndata\\n ... \\n)."""
+
+    def __init__(self, port):
+        self.s = socket.create_connection(("127.0.0.1", port), timeout=10)
+        self.f = self.s.makefile("rb")
+
+    def cmd(self, *args):
+        out = b""
+        for a in args:
+            b = a if isinstance(a, bytes) else str(a).encode()
+            out += str(len(b)).encode() + b"\n" + b + b"\n"
+        self.s.sendall(out + b"\n")
+        resp = []
+        while True:
+            ln = self.f.readline()
+            if not ln:
+                raise OSError("connection closed")
+            ln = ln.strip()
+            if ln == b"":             # blank line terminates the response
+                return resp
+            n = int(ln)
+            data = self.f.read(n)
+            self.f.readline()
+            resp.append(data)
+
+    def close(self):
+        try:
+            self.s.close()
+        except OSError:
+            pass
+
+
+@pytest.fixture()
+def stack(tmp_path):
+    apps, driver = [], None
+    try:
+        driver = ClusterDriver(
+            CFG, 3, workdir=str(tmp_path), app_ports=PORTS,
+            timeout_cfg=TimeoutConfig(elec_timeout_low=0.3,
+                                      elec_timeout_high=0.6))
+        for r, port in enumerate(PORTS):
+            env = dict(os.environ)
+            env["LD_PRELOAD"] = os.path.join(NATIVE, "interpose.so")
+            env["RP_PROXY_SOCK"] = os.path.join(str(tmp_path),
+                                                f"proxy{r}.sock")
+            conf = write_conf(str(tmp_path), r, port)
+            apps.append(subprocess.Popen(
+                [BIN, conf], env=env, cwd=SRC,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+        for port in PORTS:
+            deadline = time.time() + 30
+            while True:
+                try:
+                    socket.create_connection(("127.0.0.1", port),
+                                             timeout=1).close()
+                    break
+                except OSError:
+                    if time.time() > deadline:
+                        raise
+                    time.sleep(0.1)
+        driver.run(period=0.002)
+        deadline = time.time() + 60
+        while driver.leader() < 0 and time.time() < deadline:
+            time.sleep(0.05)
+        assert driver.leader() >= 0, "no leader elected"
+        yield driver
+    finally:
+        if driver is not None:
+            driver.stop()
+        for a in apps:
+            a.kill()
+            a.wait()
+
+
+def wait_get(port, key, want, timeout=20.0):
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        try:
+            c = SsdbClient(port)
+            resp = c.cmd("get", key)
+            c.close()
+            last = resp
+            if resp[:1] == [b"ok"] and resp[1:2] == [want]:
+                return want
+        except (OSError, ValueError, IndexError):
+            pass
+        time.sleep(0.1)
+    return last
+
+
+def test_set_replicates_to_followers(stack):
+    driver = stack
+    lead = driver.leader()
+    c = SsdbClient(PORTS[lead])
+    assert c.cmd("set", "alpha", "one")[:1] == [b"ok"]
+    assert c.cmd("get", "alpha") == [b"ok", b"one"]
+    c.close()
+    for r in range(3):
+        if r == lead:
+            continue
+        assert wait_get(PORTS[r], "alpha", b"one") == b"one", f"replica {r}"
+
+
+def test_bulk_state_equality(stack):
+    driver = stack
+    lead = driver.leader()
+    c = SsdbClient(PORTS[lead])
+    for i in range(40):
+        assert c.cmd("set", f"k{i}", f"v{i}")[:1] == [b"ok"]
+    c.close()
+    for r in range(3):
+        if r == lead:
+            continue
+        assert wait_get(PORTS[r], "k39", b"v39") == b"v39", f"replica {r}"
+        cc = SsdbClient(PORTS[r])
+        vals = [cc.cmd("get", f"k{i}")[1:2] for i in range(40)]
+        cc.close()
+        assert vals == [[b"v%d" % i] for i in range(40)], f"replica {r}"
+
+
+def test_incr_applied_exactly_once_on_followers(stack):
+    driver = stack
+    lead = driver.leader()
+    c = SsdbClient(PORTS[lead])
+    assert c.cmd("set", "ctr", "5")[:1] == [b"ok"]
+    assert c.cmd("incr", "ctr", "3") == [b"ok", b"8"]
+    assert c.cmd("incr", "ctr", "2") == [b"ok", b"10"]
+    c.close()
+    for r in range(3):
+        if r == lead:
+            continue
+        assert wait_get(PORTS[r], "ctr", b"10") == b"10", f"replica {r}"

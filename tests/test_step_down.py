@@ -1,0 +1,114 @@
+"""Lost-majority step-down — the reference leader SUICIDES when it fails
+to reach a majority (``dare_server.c:1213-1217``). Here the equivalent is
+service-level: a leader whose ``leadership_verified`` stays 0 for
+``step_down_steps`` consecutive steps fails its blocked commit waiters,
+severs/refuses replicated sessions, and resumes only when re-verified or
+deposed (strictly better than the reference's process exit, which can
+never resume)."""
+
+import os
+import socket
+import subprocess
+import threading
+import time
+
+import pytest
+
+from rdma_paxos_tpu.config import LogConfig, TimeoutConfig
+from rdma_paxos_tpu.runtime.driver import ClusterDriver
+
+NATIVE = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+CFG = LogConfig(n_slots=256, slot_bytes=128, window_slots=32, batch_slots=16)
+PORTS = [7421, 7422, 7423]
+
+
+@pytest.fixture(scope="module", autouse=True)
+def build_native():
+    subprocess.run(["make", "-C", NATIVE], check=True, capture_output=True)
+
+
+def test_minority_leader_steps_down_and_severs_clients(tmp_path):
+    apps, driver = [], None
+    try:
+        driver = ClusterDriver(
+            CFG, 3, workdir=str(tmp_path), app_ports=PORTS,
+            timeout_cfg=TimeoutConfig(elec_timeout_low=0.4,
+                                      elec_timeout_high=0.8),
+            step_down_steps=10)
+        for r, port in enumerate(PORTS):
+            env = dict(os.environ)
+            env["LD_PRELOAD"] = os.path.join(NATIVE, "interpose.so")
+            env["RP_PROXY_SOCK"] = os.path.join(str(tmp_path),
+                                                f"proxy{r}.sock")
+            apps.append(subprocess.Popen(
+                [os.path.join(NATIVE, "toyserver"), str(port)], env=env,
+                stderr=subprocess.DEVNULL))
+        time.sleep(0.3)
+        driver.run(period=0.002)
+        deadline = time.time() + 60
+        while driver.leader() < 0 and time.time() < deadline:
+            time.sleep(0.05)
+        lead = driver.leader()
+        assert lead >= 0
+
+        # a committed write, then a client parked on the leader
+        c = socket.create_connection(("127.0.0.1", PORTS[lead]), timeout=10)
+        f = c.makefile("rb")
+        c.sendall(b"SET before ok\n")
+        assert f.readline().strip() == b"+OK"
+
+        # isolate the leader WITH the client attached; its next write
+        # can never commit
+        driver.cluster.partition([[lead],
+                                  [r for r in range(3) if r != lead]])
+        c.sendall(b"SET never commits\n")
+
+        # the leader must step down (not hang the client forever): the
+        # held reply is dropped and the connection severed
+        got = []
+
+        def reader():
+            try:
+                got.append(f.readline())
+            except OSError:
+                got.append(b"")
+        t = threading.Thread(target=reader, daemon=True)
+        t.start()
+        t.join(timeout=30)
+        assert not t.is_alive(), "client still parked: no step-down"
+        assert got[0] == b"", "stale leader answered an uncommitted write"
+        assert lead in driver.stepped_down
+        c.close()
+
+        # new sessions on the stepped-down leader are refused while the
+        # partition lasts
+        s2 = socket.create_connection(("127.0.0.1", PORTS[lead]), timeout=5)
+        s2.settimeout(5)
+        try:
+            s2.sendall(b"GET before\n")
+            refused = s2.recv(64) == b""
+        except OSError:
+            refused = True
+        s2.close()
+        assert refused, "stepped-down leader served a session"
+
+        # heal: a new leader exists (majority side elected), the old one
+        # is deposed and leaves the stepped_down set
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            nl = driver.leader()
+            if nl >= 0 and nl != lead:
+                break
+            time.sleep(0.05)
+        driver.cluster.heal()
+        deadline = time.time() + 30
+        while lead in driver.stepped_down and time.time() < deadline:
+            time.sleep(0.05)
+        assert lead not in driver.stepped_down, "step-down did not clear"
+    finally:
+        if driver is not None:
+            driver.stop()
+        for a in apps:
+            a.kill()
+            a.wait()
