@@ -106,9 +106,10 @@ def run_group(R, cfg=None, reps=8):
 
 def main():
     # headline: 3-replica group (BASELINE config #1); detail adds the 5-
-    # and 7-replica groups of BASELINE configs #3/#4
+    # and 7-replica groups of BASELINE configs #3/#4 and the reference's
+    # maximum sizes 9/11/13 (MAX_SERVER_COUNT = 13, dare.h:26)
     per_group = {}
-    for R in (3, 5, 7):
+    for R in (3, 5, 7, 9, 11, 13):
         ops, step_us, committed = run_group(R)
         per_group[R] = (ops, step_us, committed)
     ops, step_us, committed = per_group[3]
@@ -122,6 +123,9 @@ def main():
             "committed": committed, "step_latency_us": round(step_us, 2),
             "ops_5_replicas": round(per_group[5][0], 1),
             "ops_7_replicas": round(per_group[7][0], 1),
+            "ops_9_replicas": round(per_group[9][0], 1),
+            "ops_11_replicas": round(per_group[11][0], 1),
+            "ops_13_replicas": round(per_group[13][0], 1),
             "backend": jax.default_backend(),
             # all R replicas' device work runs on ONE chip here (vmapped
             # axis), so ops/s ~ 1/R is the simulation topology, not the

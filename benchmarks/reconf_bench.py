@@ -11,9 +11,11 @@ reference's ``timer_start/stop`` around re-election,
   add-server       — joint-consensus upsize under load
   evict            — auto-eviction of the dead follower
 
-    python benchmarks/reconf_bench.py
+    python benchmarks/reconf_bench.py [--json RECONF.json]
 """
 
+import argparse
+import json
 import os
 import sys
 import time
@@ -23,6 +25,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 os.environ.setdefault(
     "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/rp_jax_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.2")
 import jax  # noqa: E402
 
 if os.environ.get("RP_BENCH_CPU", "1") == "1":
@@ -49,10 +53,17 @@ def drive_until(driver, cond, timeout=60.0, load_replica=None, counter=[0]):
 
 
 def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--json", default=None,
+                    help="write timings as a JSON artifact")
+    args = ap.parse_args()
+    out = {"metric": "reconfiguration_timings",
+           "backend": None, "scenarios": {}}
     d = ClusterDriver(CFG, 8, group_size=5,
                       timeout_cfg=TimeoutConfig(elec_timeout_low=0.05,
                                                 elec_timeout_high=0.15),
                       auto_evict=False, fail_threshold=30)
+    d.prewarm()          # compiles out of the timed windows
     d.cluster.run_until_elected(0)
     drive_until(d, lambda: d.leader() >= 0)
     lead = d.leader()
@@ -64,11 +75,13 @@ def main():
                     load_replica=lambda: -1)
     new_lead = d.leader()
     print(f"remove-leader: new leader {new_lead} in {t * 1e3:.0f} ms")
+    out["scenarios"]["remove_leader_new_leader_ms"] = round(t * 1e3, 1)
     base = int(d.cluster.last["commit"][new_lead])
     d.cluster.submit(new_lead, b"first-after-failover")
     t = drive_until(
         d, lambda: int(d.cluster.last["commit"][new_lead]) > base)
     print(f"remove-leader: first commit after failover +{t * 1e3:.0f} ms")
+    out["scenarios"]["remove_leader_first_commit_ms"] = round(t * 1e3, 1)
 
     # --- RemoveFollower under load ---
     d.cluster.heal()
@@ -81,6 +94,7 @@ def main():
         load_replica=lambda: d.leader())
     print(f"remove-follower: 50 commits under failure in {t * 1e3:.0f} ms "
           f"(no interruption)")
+    out["scenarios"]["remove_follower_50_commits_ms"] = round(t * 1e3, 1)
 
     # --- AddServer (upsize 5 -> 7) under load ---
     d.cluster.heal()
@@ -93,6 +107,7 @@ def main():
         load_replica=lambda: d.leader())
     print(f"add-server: upsize 5->7 committed in {t * 1e3:.0f} ms "
           f"under load")
+    out["scenarios"]["add_server_upsize_ms"] = round(t * 1e3, 1)
 
     # --- Evict a dead member ---
     d.auto_evict = True
@@ -102,9 +117,21 @@ def main():
         if d.leader() >= 0 else False,
         load_replica=lambda: d.leader(), timeout=120)
     print(f"evict: dead member removed in {t * 1e3:.0f} ms")
+    out["scenarios"]["evict_dead_member_ms"] = round(t * 1e3, 1)
 
     d.stop()
     print("all scenarios OK")
+    out["backend"] = jax.default_backend()
+    out["config"] = dict(n_slots=CFG.n_slots, slot_bytes=CFG.slot_bytes,
+                         window_slots=CFG.window_slots,
+                         batch_slots=CFG.batch_slots, replicas=8,
+                         group_size=5)
+    out["notes"] = ("in-process driver timings (the reference's "
+                    "reconf_bench.sh timer_start/stop contract, "
+                    ":17-25); election timeouts 50-150 ms")
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(out, f, indent=2)
 
 
 if __name__ == "__main__":

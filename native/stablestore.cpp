@@ -8,10 +8,18 @@
 // side (proxy.c:306-339 stablestorage_load_records).
 //
 // Format: a single file of length-prefixed records:
+//   [u64 magic][u64 base]            (header, new files only)
 //   [u32 len][len bytes] ...
-// An in-memory offset index is rebuilt by scanning on open (truncated tail
+// ``base`` is the ABSOLUTE index of the first retained record: a store
+// COMPACTED after an app-state checkpoint drops its prefix (the
+// checkpoint covers it) and keeps indices stable — record i lives at
+// position i - base. Legacy headerless files read as base = 0. All API
+// indices are absolute; ss_count returns base + live records. An
+// in-memory offset index is rebuilt by scanning on open (truncated tail
 // records from a crash are discarded — they were un-synced and thus
-// un-acked). Exposed as a flat C API for ctypes.
+// un-acked). Compaction is crash-safe: the surviving suffix is written
+// to <path>.compact and renamed over the original. Exposed as a flat C
+// API for ctypes.
 //
 // Build: make -C native   ->  libstablestore.so
 
@@ -28,8 +36,13 @@
 
 namespace {
 
+constexpr uint64_t kMagic = 0x52505353544f5231ull;  // "RPSSTOR1"
+
 struct Store {
   int fd = -1;
+  std::string path;
+  uint64_t base = 0;              // absolute index of offsets[0]
+  uint64_t data_start = 0;        // file offset of the first record
   std::vector<uint64_t> offsets;  // file offset of each record's header
   uint64_t end = 0;               // valid data end (scan watermark)
   std::mutex mu;
@@ -66,9 +79,23 @@ void* ss_open(const char* path) {
   if (fd < 0) return nullptr;
   auto* s = new Store;
   s->fd = fd;
+  s->path = path;
   struct stat st;
   if (fstat(fd, &st) != 0) { delete s; close(fd); return nullptr; }
   uint64_t size = static_cast<uint64_t>(st.st_size), off = 0;
+  if (size >= 16) {
+    uint64_t magic = 0, base = 0;
+    if (read_exact(fd, &magic, 8, 0) && magic == kMagic &&
+        read_exact(fd, &base, 8, 8)) {
+      s->base = base;
+      off = 16;
+    }
+  } else if (size == 0) {
+    // fresh store: stamp the header so compaction can persist a base
+    uint64_t hdr[2] = {kMagic, 0};
+    if (write_exact(fd, hdr, 16)) off = 16;
+  }
+  s->data_start = off;
   while (off + 4 <= size) {
     uint32_t len;
     if (!read_exact(fd, &len, 4, off)) break;
@@ -84,7 +111,7 @@ void* ss_open(const char* path) {
   return s;
 }
 
-// Append one record; returns its index, or -1 on error.
+// Append one record; returns its ABSOLUTE index, or -1 on error.
 int64_t ss_append(void* h, const void* buf, uint32_t len) {
   auto* s = static_cast<Store*>(h);
   std::lock_guard<std::mutex> lk(s->mu);
@@ -98,7 +125,7 @@ int64_t ss_append(void* h, const void* buf, uint32_t len) {
   }
   s->offsets.push_back(s->end);
   s->end += 4 + len;
-  return static_cast<int64_t>(s->offsets.size()) - 1;
+  return static_cast<int64_t>(s->base + s->offsets.size()) - 1;
 }
 
 // Append a PRE-FRAMED batch of records (([u32 len][len bytes])* — the
@@ -141,19 +168,28 @@ int ss_sync(void* h) {
   return fdatasync(s->fd) == 0 ? 0 : -1;
 }
 
+// Total records ever appended (absolute): base + live.
 int64_t ss_count(void* h) {
   auto* s = static_cast<Store*>(h);
   std::lock_guard<std::mutex> lk(s->mu);
-  return static_cast<int64_t>(s->offsets.size());
+  return static_cast<int64_t>(s->base + s->offsets.size());
 }
 
-// Read record idx into out (cap bytes). Returns record length (may exceed
-// cap, in which case only cap bytes were copied), or -1 if out of range.
+// Absolute index of the first RETAINED record (0 unless compacted).
+int64_t ss_base(void* h) {
+  auto* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
+  return static_cast<int64_t>(s->base);
+}
+
+// Read record at ABSOLUTE idx into out (cap bytes). Returns record
+// length (may exceed cap, in which case only cap bytes were copied), or
+// -1 if out of range / compacted away.
 int64_t ss_read(void* h, uint64_t idx, void* out, uint32_t cap) {
   auto* s = static_cast<Store*>(h);
   std::lock_guard<std::mutex> lk(s->mu);
-  if (idx >= s->offsets.size()) return -1;
-  uint64_t off = s->offsets[idx];
+  if (idx < s->base || idx - s->base >= s->offsets.size()) return -1;
+  uint64_t off = s->offsets[idx - s->base];
   uint32_t len;
   if (!read_exact(s->fd, &len, 4, off)) return -1;
   uint32_t n = len < cap ? len : cap;
@@ -162,6 +198,9 @@ int64_t ss_read(void* h, uint64_t idx, void* out, uint32_t cap) {
 }
 
 // Total bytes of a full dump (the snapshot payload for joiner recovery).
+// The dump is the raw file image, so a compacted store's dump CARRIES
+// its base header — the receiving side restores the same absolute
+// indexing.
 int64_t ss_dump_len(void* h) {
   auto* s = static_cast<Store*>(h);
   std::lock_guard<std::mutex> lk(s->mu);
@@ -177,11 +216,34 @@ int64_t ss_dump(void* h, void* out, uint64_t cap) {
   return static_cast<int64_t>(s->end);
 }
 
-// Append every record of a dump produced by ss_dump (joiner side).
-// Returns number of records loaded, or -1 on malformed input.
+// Append every record of a dump produced by ss_dump (joiner side). A
+// headered dump's base is adopted IF this store is empty (the reset +
+// load path); the records follow. Returns records loaded, or -1 on
+// malformed input.
 int64_t ss_load(void* h, const void* buf, uint64_t len) {
+  auto* s = static_cast<Store*>(h);
   const char* p = static_cast<const char*>(buf);
   uint64_t off = 0;
+  if (len >= 16) {
+    uint64_t magic, base;
+    memcpy(&magic, p, 8);
+    memcpy(&base, p + 8, 8);
+    if (magic == kMagic) {
+      off = 16;
+      std::lock_guard<std::mutex> lk(s->mu);
+      if (s->offsets.empty() && s->base == 0 && base != 0) {
+        uint64_t hdr[2] = {kMagic, base};
+        if (pwrite(s->fd, hdr, 16, 0) != 16) return -1;
+        if (s->data_start == 0) {
+          // legacy (headerless) empty file gained a header just now
+          s->data_start = 16;
+          s->end = 16;
+          lseek(s->fd, 16, SEEK_SET);
+        }
+        s->base = base;
+      }
+    }
+  }
   int64_t n = 0;
   while (off + 4 <= len) {
     uint32_t l;
@@ -194,16 +256,84 @@ int64_t ss_load(void* h, const void* buf, uint64_t len) {
   return off == len ? n : -1;
 }
 
-// Discard ALL records (used before re-loading a snapshot dump so history
-// is never duplicated by the append-only ss_load).
+// Discard ALL records and reset base to 0 (used before re-loading a
+// snapshot dump so history is never duplicated by the append-only
+// ss_load).
 int ss_reset(void* h) {
   auto* s = static_cast<Store*>(h);
   std::lock_guard<std::mutex> lk(s->mu);
+  uint64_t hdr[2] = {kMagic, 0};
   if (ftruncate(s->fd, 0) != 0) return -1;
   lseek(s->fd, 0, SEEK_SET);
+  if (!write_exact(s->fd, hdr, 16)) return -1;
   s->offsets.clear();
-  s->end = 0;
+  s->base = 0;
+  s->data_start = 16;
+  s->end = 16;
   return 0;
+}
+
+// Drop every record below ABSOLUTE index upto (their effects must be
+// covered by an app-state checkpoint taken at upto). Crash-safe: the
+// surviving suffix is written to <path>.compact, fsynced, and renamed
+// over the original — a crash leaves either the old or the new file.
+// Returns the new base, or -1.
+int64_t ss_compact(void* h, uint64_t upto) {
+  auto* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lk(s->mu);
+  if (upto <= s->base) return static_cast<int64_t>(s->base);
+  uint64_t live = s->offsets.size();
+  uint64_t drop = upto - s->base;
+  if (drop > live) return -1;           // cannot compact unwritten history
+  std::string tmp = s->path + ".compact";
+  int nfd = open(tmp.c_str(), O_RDWR | O_CREAT | O_TRUNC, 0644);
+  if (nfd < 0) return -1;
+  uint64_t hdr[2] = {kMagic, upto};
+  uint64_t keep_from = drop < live ? s->offsets[drop] : s->end;
+  uint64_t tail = s->end - keep_from;
+  bool ok = true;
+  {
+    size_t done = 0;
+    ok = (pwrite(nfd, hdr, 16, 0) == 16);
+    std::vector<char> cbuf(1 << 20);
+    while (ok && done < tail) {
+      size_t chunk = tail - done < cbuf.size() ? tail - done : cbuf.size();
+      ok = read_exact(s->fd, cbuf.data(), chunk, keep_from + done) &&
+           pwrite(nfd, cbuf.data(), chunk,
+                  static_cast<off_t>(16 + done)) ==
+               static_cast<ssize_t>(chunk);
+      done += chunk;
+    }
+  }
+  ok = ok && fdatasync(nfd) == 0;
+  close(nfd);
+  if (!ok) {
+    unlink(tmp.c_str());
+    return -1;
+  }
+  // reopen BEFORE the rename: if this open fails, compaction aborts
+  // with the original file still in place — renaming first and then
+  // failing to reopen would leave the process writing acked records
+  // into an orphaned inode
+  int fd = open(tmp.c_str(), O_RDWR);
+  if (fd < 0 || rename(tmp.c_str(), s->path.c_str()) != 0) {
+    if (fd >= 0) close(fd);
+    unlink(tmp.c_str());
+    return -1;
+  }
+  close(s->fd);
+  s->fd = fd;
+  // rebuild the in-memory index against the new layout
+  uint64_t shift = keep_from - 16;
+  std::vector<uint64_t> noff;
+  for (uint64_t i = drop; i < live; i++)
+    noff.push_back(s->offsets[i] - shift);
+  s->offsets.swap(noff);
+  s->base = upto;
+  s->data_start = 16;
+  s->end = 16 + tail;
+  lseek(s->fd, static_cast<off_t>(s->end), SEEK_SET);
+  return static_cast<int64_t>(s->base);
 }
 
 void ss_close(void* h) {

@@ -104,6 +104,18 @@ static void handle_line(int fd, char* line) {
     snprintf(out, sizeof out, "+OK\n");
   } else if (!strncmp(line, "COUNT", 5)) {
     snprintf(out, sizeof out, "%d\n", nkv);
+  } else if (!strncmp(line, "DUMPALL", 7)) {
+    /* full-state listing: "<key> <value>\n" per pair, "." terminator —
+     * the app-level snapshot hook bounded recovery uses (the analog of
+     * redis BGSAVE producing an RDB: app state without event history) */
+    for (unsigned i = 0; i < MAXKV; i++) {
+      if (!used[i]) continue;
+      char lineb[512];
+      int ln = snprintf(lineb, sizeof lineb, "%s %s\n", keys[i], vals[i]);
+      ssize_t w0 = write(fd, lineb, (size_t)ln);
+      (void)w0;
+    }
+    snprintf(out, sizeof out, ".\n");
   } else {
     snprintf(out, sizeof out, "-ERR\n");
   }

@@ -17,6 +17,7 @@ The shim ↔ driver wire protocol is defined in ``native/interpose.cpp``.
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import os
 import socket
@@ -235,6 +236,11 @@ def replay_store_into(store, replay: "ReplayEngine",
     order)."""
     if replay is None:
         return
+    base = getattr(store, "base", 0)
+    if start < base:
+        # records below base were compacted away; their effects must
+        # already be covered by a restored app-state checkpoint
+        start = base
     for i in range(start, len(store)):
         rec = store.read(i)
         replay.apply(rec[0], int.from_bytes(rec[1:5], "little"), rec[5:])
@@ -298,6 +304,28 @@ class ReplayEngine:
                     s.close()
                 except OSError:
                     pass
+
+    @contextlib.contextmanager
+    def raw_conn(self):
+        """Context manager: a passthrough-registered connection to the
+        local app for OUT-OF-BAND operations (app checkpoint dump /
+        restore). Bound before connecting so the driver always
+        classifies it as our own (never replicates its traffic); the
+        port registration is dropped on exit so a later real client
+        reusing the ephemeral port cannot be misclassified."""
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        self.local_ports.add(port)
+        try:
+            s.connect(self.addr)
+            yield s
+        finally:
+            self.local_ports.discard(port)
+            try:
+                s.close()
+            except OSError:
+                pass
 
     def drain_responses(self) -> None:
         """The local app writes responses to replayed connections; nobody

@@ -43,21 +43,37 @@ def atomic_write(path: str, data: bytes, durable: bool = True) -> None:
             os.close(dfd)
 
 
+_MAGIC = 0x52505353544F5231          # "RPSSTOR1" (stablestore.cpp)
+
+
 def trimmed_dump(path: str, n: int) -> bytes:
-    """Serialize the FIRST ``n`` records of the store at ``path`` — used
+    """Serialize records ``[base, n)`` of the store at ``path`` — used
     to reconstruct the store blob that pairs with a recovery point taken
-    when the (still-live, possibly longer) store had ``n`` records."""
+    when the (still-live, possibly longer) store had ``n`` records. A
+    compacted source yields a dump carrying the same base header."""
+    import struct
     import tempfile
     src = StableStore(path)
     try:
         if n >= len(src):
             return src.dump()
+        if n < src.base:
+            # the store was compacted PAST the recovery point: records
+            # [n, base) no longer exist, so a trimmed dump would be a
+            # silent hole — fail so the caller falls back to a complete
+            # recovery source
+            raise OSError(
+                "store compacted to %d, past recovery point %d"
+                % (src.base, n))
         fd, tmp = tempfile.mkstemp(suffix=".trim")
         os.close(fd)
         os.unlink(tmp)               # ss_open creates it fresh
         dst = StableStore(tmp)
         try:
-            for i in range(n):
+            if src.base:
+                # adopt the source's base (empty-store header load)
+                dst.load(struct.pack("<QQ", _MAGIC, src.base))
+            for i in range(src.base, n):
                 dst.append(src.read(i))
             return dst.dump()
         finally:
@@ -90,6 +106,10 @@ def _load() -> ctypes.CDLL:
     lib.ss_sync.argtypes = [ctypes.c_void_p]
     lib.ss_count.restype = ctypes.c_int64
     lib.ss_count.argtypes = [ctypes.c_void_p]
+    lib.ss_base.restype = ctypes.c_int64
+    lib.ss_base.argtypes = [ctypes.c_void_p]
+    lib.ss_compact.restype = ctypes.c_int64
+    lib.ss_compact.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
     lib.ss_read.restype = ctypes.c_int64
     lib.ss_read.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
                             ctypes.c_char_p, ctypes.c_uint32]
@@ -188,7 +208,26 @@ class StableStore:
             raise OSError("fdatasync failed")
 
     def __len__(self) -> int:
+        """ABSOLUTE record count (base + retained) — indices are stable
+        across compaction."""
         return int(self._lib.ss_count(self._h))
+
+    @property
+    def base(self) -> int:
+        """Absolute index of the first retained record (0 unless
+        compacted): records below it were dropped after an app-state
+        checkpoint covered their effects."""
+        return int(self._lib.ss_base(self._h))
+
+    def compact(self, upto: int) -> int:
+        """Drop records below absolute index ``upto`` (crash-safe
+        rewrite+rename). The caller must hold an app-state checkpoint
+        taken at exactly ``upto`` — a fresh app is rebuilt as
+        checkpoint + replay of [upto, len))."""
+        b = self._lib.ss_compact(self._h, upto)
+        if b < 0:
+            raise OSError("stable store compaction failed")
+        return int(b)
 
     def read(self, idx: int, cap: int = 1 << 20) -> bytes:
         buf = ctypes.create_string_buffer(cap)

@@ -34,7 +34,8 @@ from rdma_paxos_tpu.consensus.snapshot import (
     install_snapshot, recover_vote, take_snapshot)
 from rdma_paxos_tpu.consensus.state import ConfigState, Role
 from rdma_paxos_tpu.proxy.proxy import PendingEvent, ProxyServer, ReplayEngine
-from rdma_paxos_tpu.proxy.stablestore import HardState, StableStore
+from rdma_paxos_tpu.proxy.stablestore import (
+    HardState, StableStore, atomic_write)
 from rdma_paxos_tpu.runtime.sim import SimCluster
 from rdma_paxos_tpu.runtime.timers import ElectionTimer
 from rdma_paxos_tpu.utils.debug import ReplicaLog
@@ -97,9 +98,22 @@ class ClusterDriver:
                  group_size: Optional[int] = None,
                  mode: str = "sim", seed: int = 0,
                  auto_evict: bool = False, fail_threshold: int = 100,
-                 sync_period: float = 0.05, step_down_steps: int = 50):
+                 sync_period: float = 0.05, step_down_steps: int = 50,
+                 app_snapshot=None):
         self.cfg = cfg
         self.sync_period = sync_period
+        self._workdir = workdir
+        # bounded recovery: optional app-level snapshot hook pair
+        # (dump_fn(sock)->bytes, restore_fn(sock, blob)) speaking the
+        # app's own protocol over a passthrough connection. With it,
+        # checkpoint_app() captures a follower's app state at a known
+        # store index and COMPACTS the store prefix it covers, so donor
+        # transfer and fresh-app rebuild become O(app state + suffix)
+        # instead of O(entire history) — exceeding the reference, whose
+        # snapshot is always the full BDB record stream
+        # (db-interface.c:98-134).
+        self.app_snapshot = app_snapshot
+        self._ckpt_req: Optional[Tuple[int, threading.Event, list]] = None
         # lost-majority step-down (the reference leader SUICIDES after
         # failing to reach a majority, dare_server.c:1213-1217): a
         # leader whose leadership_verified stays 0 for this many
@@ -125,13 +139,14 @@ class ClusterDriver:
         # phase resets so eviction/request can be re-issued
         self._config_phase: Optional[Tuple[str, int, int, int]] = None
         self.config_changes_abandoned = 0
-        # recovery requests execute inside the poll loop (never racing the
-        # stepping thread over cluster.state): (replica, donor, done_event)
-        self._recover_req: Optional[Tuple[int, Optional[int],
-                                          threading.Event]] = None
+        # recovery requests execute inside the poll loop (never racing
+        # the stepping thread over cluster.state): (replica, donor,
+        # done_event, exception_box) — failures surface to the caller,
+        # never kill the loop
+        self._recover_req = None
         # app-reset requests (mis-speculation quarantine exit), same
-        # poll-loop execution discipline: (replica, done_event)
-        self._reset_req: Optional[Tuple[int, threading.Event]] = None
+        # poll-loop execution discipline: (replica, done_event, box)
+        self._reset_req = None
         self._lock = threading.Lock()
         # per-replica queues of (etype, conn_id, fragment_bytes, seq)
         self._submitq: List[List[Tuple[int, int, bytes, int]]]
@@ -250,17 +265,31 @@ class ClusterDriver:
         req = self._recover_req
         if req is not None:
             self._recover_req = None
-            r, donor, done = req
+            r, donor, done, box = req
             try:
                 self._do_recover(r, donor)
+            except Exception as exc:  # noqa: BLE001 — reported to caller
+                box.append(exc)
             finally:
                 done.set()
         rreq = self._reset_req
         if rreq is not None:
             self._reset_req = None
-            r, done = rreq
+            r, done, box = rreq
             try:
                 self._do_reset_app(r)
+            except Exception as exc:  # noqa: BLE001 — reported to caller
+                box.append(exc)
+            finally:
+                done.set()
+        creq = self._ckpt_req
+        if creq is not None:
+            self._ckpt_req = None
+            r, done, box = creq
+            try:
+                self._do_checkpoint(r)
+            except Exception as exc:  # noqa: BLE001 — reported to caller
+                box.append(exc)
             finally:
                 done.set()
         with self._lock:
@@ -371,7 +400,16 @@ class ClusterDriver:
             cands = self.cluster.need_recovery - {self._leader_view}
             if cands:
                 r = min(cands)
-                self._do_recover(r, None, app_fresh=False)
+                try:
+                    self._do_recover(r, None, app_fresh=False)
+                except RuntimeError as exc:
+                    # unrecoverable in place (e.g. the donor compacted
+                    # past this app's applied prefix): quarantine the
+                    # app for an operator restart + reset_app rather
+                    # than killing the poll loop or retrying forever
+                    rt = self.runtimes[r]
+                    rt.app_dirty = True
+                    rt.log.info_wtime("AUTO-RECOVERY FAILED: %s" % exc)
                 self.cluster.need_recovery.discard(r)
         return res
 
@@ -512,15 +550,18 @@ class ClusterDriver:
         rebuilt by replaying the store. Executes inside the poll loop so
         it never races the stepping thread over cluster state."""
         done = threading.Event()
+        box: list = []
         with self._lock:
             if self._recover_req is not None:
                 raise RuntimeError("a recovery request is already pending")
-            self._recover_req = (r, donor, done)
+            self._recover_req = (r, donor, done, box)
         self._wake.set()
         if self._thread is None or not self._thread.is_alive():
             self.step()
         elif not done.wait(timeout):
             raise TimeoutError("recovery did not run (loop stalled?)")
+        if box:
+            raise box[0]
 
     def reset_app(self, r: int, timeout: float = 60.0) -> None:
         """Exit mis-speculation quarantine: the operator has restarted
@@ -528,15 +569,87 @@ class ClusterDriver:
         committed store (complete — persistence continued while dirty)
         and resume live replay. Executes inside the poll loop."""
         done = threading.Event()
+        box: list = []
         with self._lock:
             if self._reset_req is not None:
                 raise RuntimeError("an app reset is already pending")
-            self._reset_req = (r, done)
+            self._reset_req = (r, done, box)
         self._wake.set()
         if self._thread is None or not self._thread.is_alive():
             self.step()
         elif not done.wait(timeout):
             raise TimeoutError("app reset did not run (loop stalled?)")
+        if box:
+            raise box[0]
+
+    def _ckpt_path(self, r: int) -> Optional[str]:
+        if self._workdir is None:
+            return None
+        return os.path.join(self._workdir, f"replica{r}.ckpt")
+
+    def _read_ckpt(self, r: int):
+        """-> (index, blob) of replica ``r``'s app checkpoint, or None."""
+        path = self._ckpt_path(r)
+        if path is None or not os.path.exists(path):
+            return None
+        import struct
+        with open(path, "rb") as f:
+            raw = f.read()
+        if len(raw) < 8:
+            return None
+        return struct.unpack("<Q", raw[:8])[0], raw[8:]
+
+    def checkpoint_app(self, r: int, timeout: float = 60.0) -> None:
+        """Capture replica ``r``'s app state (follower only — a
+        speculative leader's app runs AHEAD of commit) at its current
+        store index, persist it, and compact the store prefix it covers.
+        Executes inside the poll loop so the app/store pair is frozen at
+        a consistent point."""
+        done = threading.Event()
+        box: list = []
+        with self._lock:
+            if self._ckpt_req is not None:
+                raise RuntimeError("a checkpoint is already pending")
+            self._ckpt_req = (r, done, box)
+        self._wake.set()
+        if self._thread is None or not self._thread.is_alive():
+            self.step()
+        elif not done.wait(timeout):
+            raise TimeoutError("checkpoint did not run (loop stalled?)")
+        if box:
+            raise box[0]
+
+    def _do_checkpoint(self, r: int) -> None:
+        import struct
+        rt = self.runtimes[r]
+        if self.app_snapshot is None:
+            raise RuntimeError("no app_snapshot hook configured")
+        if rt.replay is None or rt.store is None:
+            raise RuntimeError("replica has no app/store")
+        if self._leader_view == r:
+            raise RuntimeError(
+                "checkpoint must come from a follower: a speculative "
+                "leader's app state runs ahead of commit")
+        if rt.app_dirty:
+            raise RuntimeError("cannot checkpoint a dirty app")
+        dump_fn, _ = self.app_snapshot
+        # the app has executed exactly store[base, n): _apply_new_entries
+        # feeds the store and the app in the same sweep, and nothing
+        # advances between poll-loop control requests and the next sweep
+        n = len(rt.store)
+        with rt.replay.raw_conn() as s:
+            blob = dump_fn(s)
+        path = self._ckpt_path(r)
+        atomic_write(path, struct.pack("<Q", n) + blob)
+        rt.store.compact(n)
+        rt.log.info_wtime(
+            "CHECKPOINT: app state at record %d (%d bytes); store "
+            "compacted" % (n, len(blob)))
+
+    def _restore_ckpt(self, rt: _ReplicaRuntime, ckpt) -> None:
+        _, restore_fn = self.app_snapshot
+        with rt.replay.raw_conn() as s:
+            restore_fn(s, ckpt[1])
 
     def _do_reset_app(self, r: int) -> None:
         rt = self.runtimes[r]
@@ -544,6 +657,16 @@ class ClusterDriver:
             rt.replay.close()
             rt.replay = ReplayEngine("127.0.0.1", rt.app_port)
         if rt.store is not None and rt.replay is not None:
+            if rt.store.base > 0:
+                # the compacted prefix is covered by this replica's own
+                # app checkpoint: restore it, then replay the suffix
+                ckpt = self._read_ckpt(r)
+                if (ckpt is None or ckpt[0] != rt.store.base
+                        or self.app_snapshot is None):
+                    raise RuntimeError(
+                        "store compacted to %d but no matching app "
+                        "checkpoint to rebuild from" % rt.store.base)
+                self._restore_ckpt(rt, ckpt)
             from rdma_paxos_tpu.proxy.proxy import replay_store_into
             replay_store_into(rt.store, rt.replay, start=0)
         rt.app_dirty = False
@@ -587,11 +710,37 @@ class ClusterDriver:
             old_len = len(rrt.store)
             rrt.store.reset()
             rrt.store.load(snap.store_blob)
+            base = rrt.store.base
+            if base > 0:
+                # the donor's store was compacted behind its app
+                # checkpoint: carry the checkpoint over so r (and any
+                # later reset of r) can cover the missing prefix
+                if self.app_snapshot is None:
+                    raise RuntimeError(
+                        "donor %d store is compacted (base %d) but no "
+                        "app_snapshot hook is configured to restore its "
+                        "checkpoint" % (donor, base))
+                ckpt = self._read_ckpt(donor)
+                if ckpt is None or ckpt[0] != base:
+                    raise RuntimeError(
+                        "donor %d store compacted to %d but no matching "
+                        "app checkpoint" % (donor, base))
+                import shutil
+                if self._ckpt_path(r) is not None:
+                    shutil.copyfile(self._ckpt_path(donor),
+                                    self._ckpt_path(r))
+                if app_fresh:
+                    self._restore_ckpt(rrt, ckpt)
+                elif old_len < base:
+                    raise RuntimeError(
+                        "live app executed only %d records but the "
+                        "donor history now starts at %d — restart the "
+                        "app and use reset_app" % (old_len, base))
             from rdma_paxos_tpu.proxy.proxy import replay_store_into
-            # fresh app: rebuild with the full history; live app (auto
-            # recovery): deliver only the records beyond the prefix it
-            # already executed — its own old store (a prefix of the
-            # donor's, both being the committed order)
+            # fresh app: rebuild checkpoint + full retained history;
+            # live app (auto recovery): deliver only the records beyond
+            # the prefix it already executed — its own old store (a
+            # prefix of the donor's, both being the committed order)
             replay_store_into(rrt.store, rrt.replay,
                               start=0 if app_fresh else old_len)
 

@@ -36,8 +36,20 @@ def random_partition(rng, R):
 
 @pytest.mark.parametrize("seed", [1, 2, 3, 4, 5])
 def test_random_schedule_preserves_safety(seed):
+    _fuzz_schedule(seed, random.Random(seed).choice([3, 5]))
+
+
+@pytest.mark.parametrize("R", [9, 11, 13])
+def test_random_schedule_max_group_sizes(R):
+    """The reference supports 1..13 replicas (MAX_SERVER_COUNT,
+    dare.h:26); run the same safety fuzz at its maximum group sizes —
+    the quorum kernel pads to 128 lanes, so this exercises test
+    coverage, not new code paths."""
+    _fuzz_schedule(100 + R, R)
+
+
+def _fuzz_schedule(seed, R):
     rng = random.Random(seed)
-    R = rng.choice([3, 5])
     c = SimCluster(CFG, R)
     prev_commit = np.zeros(R, np.int64)
     seen_terms = {}          # term -> leader id (I4)

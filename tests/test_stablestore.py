@@ -67,3 +67,46 @@ def test_torn_tail_record_dropped(tmp_path):
         assert s.read(0) == b"good"
         s.append(b"next")             # and the store keeps working
         assert len(s) == 2
+
+
+def test_compaction_preserves_absolute_indices(tmp_path):
+    """compact(n) drops records below n but indices stay ABSOLUTE: the
+    suffix reads back at its original positions, appends continue the
+    numbering, and the base survives close/reopen (it lives in the file
+    header, not memory)."""
+    p = str(tmp_path / "cp.db")
+    with StableStore(p) as s:
+        for i in range(10):
+            s.append(b"rec-%d" % i)
+        assert s.base == 0
+        assert s.compact(6) == 6
+        assert s.base == 6
+        assert len(s) == 10
+        assert s.read(6) == b"rec-6"
+        assert s.read(9) == b"rec-9"
+        with pytest.raises(IndexError):
+            s.read(5)                 # compacted away
+        assert s.append(b"rec-10") == 10
+    with StableStore(p) as s:         # base is durable
+        assert s.base == 6
+        assert len(s) == 11
+        assert s.read(10) == b"rec-10"
+
+
+def test_compacted_dump_carries_base(tmp_path):
+    """A compacted store's dump restores the same absolute indexing on
+    the receiving side (donor transfer of checkpoint + suffix)."""
+    src_p = str(tmp_path / "src2.db")
+    with StableStore(src_p) as src:
+        for i in range(8):
+            src.append(b"e%d" % i)
+        src.compact(5)
+        blob = src.dump()
+    with StableStore(str(tmp_path / "dst2.db")) as dst:
+        dst.reset()
+        assert dst.load(blob) == 3
+        assert dst.base == 5
+        assert len(dst) == 8
+        assert dst.read(7) == b"e7"
+        with pytest.raises(IndexError):
+            dst.read(4)
