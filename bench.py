@@ -67,6 +67,7 @@ def build(R, cfg=None):
             timeout_fired=jnp.zeros((R,), jnp.int32),
             peer_mask=peer,
             apply_done=state.commit,
+            queue_depth=jnp.zeros((R,), jnp.int32),
         )
         state, out = vstep(state, inp)
         return state, out.commit[0]
@@ -81,7 +82,8 @@ def build(R, cfg=None):
             batch_data=batch_data, batch_meta=batch_meta,
             batch_count=jnp.zeros((R,), jnp.int32),
             timeout_fired=jnp.zeros((R,), jnp.int32).at[0].set(1),
-            peer_mask=peer, apply_done=state.commit)
+            peer_mask=peer, apply_done=state.commit,
+            queue_depth=jnp.zeros((R,), jnp.int32))
         state, _ = vstep(state, inp)
         return state
 

@@ -73,7 +73,8 @@ def build(cfg: LogConfig, batch: int, use_pallas=None):
             batch_data=data, batch_meta=meta,
             batch_count=jnp.full((R,), count, jnp.int32),
             timeout_fired=jnp.zeros((R,), jnp.int32),
-            peer_mask=peer, apply_done=state.commit)
+            peer_mask=peer, apply_done=state.commit,
+            queue_depth=jnp.zeros((R,), jnp.int32))
 
     @jax.jit
     def one(state):

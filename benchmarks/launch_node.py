@@ -38,6 +38,12 @@ def main():
     n = int(os.environ["group_size"])
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # persistent compile cache: the step/burst programs are identical
+    # across node restarts — never pay a mid-serving JIT pause twice
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                          "/tmp/rp_jax_cache")
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
+                          "0.2")
     import jax
     if os.environ.get("RP_BENCH_CPU", "1") == "1":
         jax.config.update("jax_platforms", "cpu")
@@ -55,6 +61,7 @@ def main():
     node = NodeDaemon(cfg, process_id=idx, num_processes=n,
                       coordinator=args.coordinator, workdir=args.workdir,
                       app_port=args.app_port or None, timeout_cfg=timing)
+    node.prewarm_burst()     # collective: compile bursts out of serving
 
     app = None
     if args.app_port:

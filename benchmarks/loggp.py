@@ -65,7 +65,8 @@ def step_time(cfg, batch_fill, reps=SAMPLES):
             batch_count=jnp.full((R,), count, jnp.int32),
             timeout_fired=jnp.zeros((R,), jnp.int32).at[0].set(tmo),
             peer_mask=jnp.ones((R, R), jnp.int32),
-            apply_done=commit)
+            apply_done=commit,
+            queue_depth=jnp.zeros((R,), jnp.int32))
 
     state, _ = vstep(state, make_inp(0, 1, jnp.zeros((R,), jnp.int32)))
     ts = []

@@ -78,9 +78,12 @@ I32_MAX = jnp.iinfo(jnp.int32).max
 
 # control-gather columns (C_VTERM/C_VFOR carry each replica's durable vote
 # pair so vote records refresh on EVERY step — full or stable — not only
-# through the election-phase vote gather)
+# through the election-phase vote gather; C_QDEP carries each host's
+# submit backlog so every host derives the SAME burst-size hint — the
+# collective-count coordination that lets multihost drivers dispatch
+# fused multi-step bursts without an extra gather)
 (C_TERM, C_ROLE, C_END, C_COMMIT, C_LTERM, C_APPLY, C_TMO,
- C_VTERM, C_VFOR, C_N) = range(10)
+ C_VTERM, C_VFOR, C_QDEP, C_N) = range(11)
 # window-message scalar columns
 S_VALID, S_WSTART, S_WCOUNT, S_TERM, S_PREV, S_COMMIT, S_HEAD, S_N = range(8)
 
@@ -96,6 +99,10 @@ class StepInput:
     timeout_fired: jax.Array  # i32 — host election timer expired
     peer_mask: jax.Array     # [R] i32 — which peers this replica can hear
     apply_done: jax.Array    # i32 — host's applied index (echo)
+    queue_depth: jax.Array   # i32 — host submit backlog beyond this batch
+                             #   (rides the control gather; feeds the
+                             #   burst-size hint every host computes
+                             #   identically)
 
 
 @jax.tree_util.register_dataclass
@@ -127,6 +134,10 @@ class StepOutput:
                               # authority THIS step, so reads at commit are
                               # linearizable (rc_verify_leadership analog,
                               # dare_ibv_rc.c:1182-1280)
+    burst_hint: jax.Array     # max queue depth heard from any self-claimed
+                              # leader (identical on every host under full
+                              # connectivity): hosts use it to agree on a
+                              # fused multi-step burst size next iteration
 
 
 def make_step_input(cfg: LogConfig, n_replicas: int) -> StepInput:
@@ -139,6 +150,7 @@ def make_step_input(cfg: LogConfig, n_replicas: int) -> StepInput:
         timeout_fired=jnp.zeros((), i32),
         peer_mask=jnp.ones((n_replicas,), i32),
         apply_done=jnp.zeros((), i32),
+        queue_depth=jnp.zeros((), i32),
     )
 
 
@@ -248,6 +260,7 @@ def replica_step(
     ctrl = ctrl.at[C_TMO].set(inp.timeout_fired)
     ctrl = ctrl.at[C_VTERM].set(state.voted_term)
     ctrl = ctrl.at[C_VFOR].set(state.voted_for)
+    ctrl = ctrl.at[C_QDEP].set(inp.queue_depth)
     allc = lax.all_gather(ctrl, axis_name)                  # [R, C_N]
 
     g_term, g_end = allc[:, C_TERM], allc[:, C_END]
@@ -689,6 +702,9 @@ def replica_step(
             & ((transit2 <= 0)
                | (jnp.sum((heard & (g_acks[:, 1] == me)).astype(i32)
                           * in_old2) >= maj_old2))).astype(i32),
+        burst_hint=jnp.max(jnp.where(
+            heard & (allc[:, C_ROLE] == int(Role.LEADER)),
+            allc[:, C_QDEP], 0)).astype(i32),
     )
     return new_state, out
 

@@ -115,17 +115,20 @@ def build_sim_burst(cfg: LogConfig, n_replicas: int, *,
     vstep = jax.vmap(core, in_axes=(0, 0), axis_name=REPLICA_AXIS)
     zeros_r = jnp.zeros((n_replicas,), jnp.int32)
 
-    def burst(state_b, datas, metas, counts, peer_mask, applied):
+    def burst(state_b, datas, metas, counts, peer_mask, applied, qdepth):
         # datas [K, R, B, sw]; metas [K, R, B, MW]; counts [K, R];
         # applied [R] = the HOST's true apply cursors, frozen across the
         # burst — echoing st.commit here would let pressure-gated (and
-        # forced) pruning recycle slots the host has not replayed yet
+        # forced) pruning recycle slots the host has not replayed yet.
+        # qdepth [R] = the host backlog REMAINING beyond this burst, so
+        # the final step's gathered burst_hint keeps bursts back-to-back
+        # under sustained load instead of resetting to zero
         def body(st, xs):
             d, m, c = xs
             inp = StepInput(
                 batch_data=d, batch_meta=m, batch_count=c,
                 timeout_fired=zeros_r, peer_mask=peer_mask,
-                apply_done=applied)
+                apply_done=applied, queue_depth=qdepth)
             st, out = vstep(st, inp)
             return st, out
         return lax.scan(body, state_b, (datas, metas, counts))
@@ -146,7 +149,7 @@ def build_spmd_burst(cfg: LogConfig, n_replicas: int, mesh: Mesh, *,
         fanout=fanout, elections=False)
 
     def per_device(state_b, datas_b, metas_b, counts_b, peer_b,
-                   applied_b):
+                   applied_b, qdepth_b):
         st = _squeeze(state_b)
 
         def body(s, xs):
@@ -154,7 +157,10 @@ def build_spmd_burst(cfg: LogConfig, n_replicas: int, mesh: Mesh, *,
             inp = StepInput(
                 batch_data=d[0], batch_meta=m[0], batch_count=c[0],
                 timeout_fired=jnp.zeros((), jnp.int32),
-                peer_mask=peer_b[0], apply_done=applied_b[0])
+                peer_mask=peer_b[0], apply_done=applied_b[0],
+                # remaining backlog rides every burst step's gather so
+                # the final burst_hint sustains back-to-back bursts
+                queue_depth=qdepth_b[0])
             s, out = core(s, inp)
             return s, out
         st, outs = lax.scan(body, st, (datas_b, metas_b, counts_b))
@@ -165,7 +171,7 @@ def build_spmd_burst(cfg: LogConfig, n_replicas: int, mesh: Mesh, *,
         per_device, mesh=mesh,
         in_specs=(P(REPLICA_AXIS), P(None, REPLICA_AXIS),
                   P(None, REPLICA_AXIS), P(None, REPLICA_AXIS),
-                  P(REPLICA_AXIS), P(REPLICA_AXIS)),
+                  P(REPLICA_AXIS), P(REPLICA_AXIS), P(REPLICA_AXIS)),
         out_specs=(P(REPLICA_AXIS), P(None, REPLICA_AXIS)),
         check_vma=False)
     return jax.jit(mapped, donate_argnums=(0,) if donate else ())

@@ -293,6 +293,15 @@ class GroupController:
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._serve, daemon=True)
         self._thread.start()
+        # the CUTTER owns the settle deadline: cuts fire the moment the
+        # expected membership is complete (event-driven) or when the
+        # settle window elapses after the last registration — never
+        # dependent on the timing of the NEXT incoming RPC (the old
+        # behavior re-evaluated only inside request handlers, making
+        # cut latency a function of worker poll cadence: the elastic
+        # suite's flake locus)
+        self._cutter = threading.Thread(target=self._cut_loop, daemon=True)
+        self._cutter.start()
 
     # ------------------------------------------------------------------
 
@@ -346,7 +355,17 @@ class GroupController:
                     return
         elif len(hosts) < self.expect:
             return
-        if time.monotonic() - self._reg_changed < self.settle:
+        # event-driven cut: a REBUILD with every previous member back
+        # has nobody to settle for — cut immediately. Fresh worlds and
+        # partial-survivor rebuilds wait out the settle window (batching
+        # near-simultaneous registrations — a fresh boot of MORE than
+        # `expect` hosts must not cut at the expect-th registration and
+        # immediately churn on the next newcomer); the cutter thread
+        # owns that deadline.
+        full = bool(self._prev_members) and (
+            set(self._prev_members) <= set(hosts))
+        if (not full
+                and time.monotonic() - self._reg_changed < self.settle):
             return
         # the generation's workers still running must have been told to
         # exit before their hosts re-registered; hosts in _reg are idle
@@ -396,6 +415,27 @@ class GroupController:
         self._regen_wanted = False
         self._barriers.clear()
         self._lock.notify_all()
+
+    def _cut_loop(self) -> None:
+        """Re-evaluate pending cuts when the settle deadline passes —
+        independent of RPC arrival timing."""
+        with self._lock:
+            while not self._stop.is_set():
+                before = self._gen
+                self._maybe_cut()
+                if self._gen != before:
+                    continue
+                if self._reg and (self._spec is None
+                                  or self._regen_wanted):
+                    left = (self._reg_changed + self.settle
+                            - time.monotonic())
+                    # settle deadline already passed but the cut is
+                    # blocked on something else (majority overlap /
+                    # donor eligibility): no point busy-waking — only a
+                    # registration (which notifies) can unblock it
+                    self._lock.wait(timeout=left if left > 0 else 1.0)
+                else:
+                    self._lock.wait(timeout=1.0)
 
     def _handle(self, req: dict) -> dict:
         op = req.get("op")
@@ -492,6 +532,8 @@ class GroupController:
 
     def close(self) -> None:
         self._stop.set()
+        with self._lock:
+            self._lock.notify_all()    # release the cutter promptly
         try:
             self._srv.close()
         except OSError:

@@ -103,6 +103,11 @@ def main() -> None:
         app_port=args.app_port or None, timeout_cfg=timing,
         host_id=args.host_id, genesis=genesis,
         seed=spec["gen"] * 1000, gen=int(spec["gen"]))
+    # COLLECTIVE: compile the burst program before serving (no-op when
+    # bursts are disabled for this backend) — the multi-process compile
+    # must never land mid-drain (the persistent cache does not serve
+    # these programs)
+    node.prewarm_burst()
 
     if args.app_port:
         # the supervisor starts the app once our proxy socket exists;

@@ -72,6 +72,10 @@ class HostReplicaDriver:
             cfg, self.R, self.mesh, fanout=fanout,
             # same kernel as the benches: Pallas quorum scan on TPU
             use_pallas=jax.default_backend() == "tpu")
+        # one jitted burst builder (lazily built): the scan length
+        # follows the [K, ...] input shape, so jit specializes per K
+        self._burst = None
+        self._ksharding = NamedSharding(self.mesh, P(None, REPLICA_AXIS))
 
         # HOST-LOCAL window fetch: reads THIS replica's log shard only —
         # a single-device program outside the SPMD step, so hosts may
@@ -160,7 +164,7 @@ class HostReplicaDriver:
                    timeout_fired: bool = False,
                    apply_done: int = 0,
                    peer_mask: Optional[np.ndarray] = None,
-                   gen: int = 0) -> StepInput:
+                   gen: int = 0, queue_depth: int = 0) -> StepInput:
         cfg, B = self.cfg, self.cfg.batch_slots
         data = np.zeros((B, cfg.slot_words), np.int32)
         meta = np.zeros((B, META_W), np.int32)
@@ -194,6 +198,8 @@ class HostReplicaDriver:
             peer_mask=self._global_from_local(pm, fill=1),
             apply_done=self._global_from_local(
                 np.asarray(apply_done, np.int32)),
+            queue_depth=self._global_from_local(
+                np.asarray(queue_depth, np.int32)),
         )
 
     def step(self, **kw) -> Dict[str, np.ndarray]:
@@ -205,11 +211,86 @@ class HostReplicaDriver:
         for k in ("term", "role", "leader_id", "voted_term", "voted_for",
                   "head", "apply", "commit",
                   "end", "hb_seen", "became_leader", "acked", "accepted",
-                  "leadership_verified"):
+                  "leadership_verified", "burst_hint"):
             arr = getattr(out, k)
             local = [s for s in arr.addressable_shards
                      if s.index[0].start == self.me]
             res[k] = np.asarray(local[0].data[0]) if local else None
+        return res
+
+    def _kglobal(self, local_k: np.ndarray, fill=0) -> jax.Array:
+        """[K, R, ...] global array sharded on axis 1; this host provides
+        column ``me`` (other columns come from the other hosts)."""
+        shards = []
+        for d in self.mesh.devices.flat:
+            if d.process_index != jax.process_index():
+                continue
+            col = (local_k if d == self._local_dev
+                   else np.full_like(local_k, fill))
+            shards.append(jax.device_put(col[:, None], d))
+        return jax.make_array_from_single_device_arrays(
+            (local_k.shape[0], self.R) + local_k.shape[1:],
+            self._ksharding, shards)
+
+    def _burst_fn(self):
+        if self._burst is None:
+            from rdma_paxos_tpu.parallel.mesh import build_spmd_burst
+            self._burst = build_spmd_burst(
+                self.cfg, self.R, self.mesh, fanout=self._fanout,
+                use_pallas=jax.default_backend() == "tpu")
+        return self._burst
+
+    def step_burst(self, K: int,
+                   batches: Sequence[Sequence[Tuple[int, int, int,
+                                                    bytes]]] = (),
+                   apply_done: int = 0, gen: int = 0,
+                   queue_depth: int = 0) -> Dict[str, np.ndarray]:
+        """K fused protocol steps in ONE collective dispatch. EVERY host
+        must call this in the same iteration with the SAME K (derived
+        from the gathered ``burst_hint`` — identical on all hosts under
+        full connectivity; each distinct K is a separate compile, so
+        drivers should stick to one K). ``batches``: up to K client
+        batches for this host (empty on followers). ``queue_depth``:
+        backlog REMAINING beyond this burst — it rides every burst
+        step's gather so the final ``burst_hint`` sustains back-to-back
+        bursts. No election timeouts fire inside a burst (each step
+        carries the heartbeat). Returns this replica's final-step
+        outputs plus ``accepted`` summed over the burst."""
+        assert K > 0, K
+        cfg, B = self.cfg, self.cfg.batch_slots
+        data = np.zeros((K, B, cfg.slot_words), np.int32)
+        meta = np.zeros((K, B, META_W), np.int32)
+        count = np.zeros((K,), np.int32)
+        for k, batch in enumerate(list(batches)[:K]):
+            for i, (etype, conn, req, payload) in enumerate(batch[:B]):
+                data[k, i] = bytes_to_words(payload, cfg.slot_words)
+                meta[k, i, M_TYPE] = etype
+                meta[k, i, M_CONN] = conn
+                meta[k, i, M_REQID] = req
+                meta[k, i, M_LEN] = len(payload)
+                meta[k, i, M_GEN] = gen
+            count[k] = min(len(batch), B)
+        fn = self._burst_fn()
+        pm = self._global_from_local(np.ones(self.R, np.int32), fill=1)
+        ap = self._global_from_local(np.asarray(apply_done, np.int32))
+        qd = self._global_from_local(np.asarray(queue_depth, np.int32))
+        self.state, outs = fn(self.state, self._kglobal(data),
+                              self._kglobal(meta), self._kglobal(count),
+                              pm, ap, qd)
+        res = {}
+        for k in ("term", "role", "leader_id", "voted_term", "voted_for",
+                  "head", "apply", "commit", "end", "hb_seen",
+                  "became_leader", "acked", "accepted",
+                  "leadership_verified", "burst_hint"):
+            arr = getattr(outs, k)            # [K, R, ...]
+            local = [s for s in arr.addressable_shards
+                     if s.index[1].start == self.me]
+            res[k] = (np.asarray(local[0].data[-1, 0])
+                      if local else None)
+        if res["accepted"] is not None:
+            acc = [s for s in outs.accepted.addressable_shards
+                   if s.index[1].start == self.me]
+            res["accepted"] = np.asarray(acc[0].data[:, 0]).sum()
         return res
 
     def export_local_row(self) -> dict:

@@ -174,6 +174,8 @@ class SimCluster:
             timeout_fired=jnp.asarray(tmo),
             peer_mask=jnp.asarray(self.peer_mask),
             apply_done=jnp.asarray(self.applied.astype(np.int32)),
+            queue_depth=jnp.asarray(
+                np.array([len(q) for q in self.pending], np.int32)),
         )
 
     # burst size tiers: the smallest tier >= the steps needed is compiled
@@ -241,7 +243,10 @@ class SimCluster:
         self.state, outs = fn(self.state, jnp.asarray(data),
                               jnp.asarray(meta), jnp.asarray(count),
                               jnp.asarray(self.peer_mask),
-                              jnp.asarray(self.applied.astype(np.int32)))
+                              jnp.asarray(self.applied.astype(np.int32)),
+                              jnp.asarray(np.array(
+                                  [len(q) for q in self.pending],
+                                  np.int32)))
         res = {k: np.asarray(getattr(outs, k))[-1]
                for k in ("term", "role", "leader_id", "voted_term",
                          "voted_for", "head", "apply", "commit", "end",
@@ -297,7 +302,8 @@ class SimCluster:
             batch_count=jnp.zeros((R,), jnp.int32),
             timeout_fired=jnp.zeros((R,), jnp.int32),
             peer_mask=jnp.asarray(self.peer_mask),
-            apply_done=jnp.zeros((R,), jnp.int32))
+            apply_done=jnp.zeros((R,), jnp.int32),
+            queue_depth=jnp.zeros((R,), jnp.int32))
         for elections in (True, False):
             fn = self._build_step(elections=elections)
             st = jax.tree.map(lambda x: x.copy(), self.state)
@@ -309,7 +315,8 @@ class SimCluster:
             st = jax.tree.map(lambda x: x.copy(), self.state)
             fn(st, jnp.zeros((K, R, B, cfg.slot_words), jnp.int32),
                jnp.zeros((K, R, B, META_W), jnp.int32),
-               jnp.zeros((K, R), jnp.int32), pm, ap)
+               jnp.zeros((K, R), jnp.int32), pm, ap,
+               jnp.zeros((R,), jnp.int32))
 
     def step(self, timeouts: Sequence[int] = ()) -> Dict[str, np.ndarray]:
         timeouts = list(timeouts)       # may be a one-shot iterable
