@@ -78,6 +78,13 @@ def main():
     ap.add_argument("--slot-bytes", type=int, default=512)
     ap.add_argument("--window-slots", type=int, default=64)
     ap.add_argument("--batch-slots", type=int, default=64)
+    ap.add_argument("--fanout", default="psum",
+                    choices=("psum", "gather"),
+                    help="window fan-out: psum is the production "
+                         "full-connectivity config (O(W) per replica)")
+    ap.add_argument("--sync-period", type=float, default=0.2,
+                    help="store fdatasync cadence (durability matches "
+                         "the reference's quorum-memory contract)")
     args = ap.parse_args()
 
     try:
@@ -107,7 +114,8 @@ def main():
     driver = ClusterDriver(
         cfg, args.replicas, workdir=wd, app_ports=ports,
         timeout_cfg=TimeoutConfig(elec_timeout_low=0.5,
-                                  elec_timeout_high=1.0))
+                                  elec_timeout_high=1.0),
+        fanout=args.fanout, sync_period=args.sync_period)
     apps = []
     for r, port in enumerate(ports):
         env = dict(os.environ)

@@ -99,7 +99,7 @@ class ClusterDriver:
                  mode: str = "sim", seed: int = 0,
                  auto_evict: bool = False, fail_threshold: int = 100,
                  sync_period: float = 0.05, step_down_steps: int = 50,
-                 app_snapshot=None):
+                 app_snapshot=None, fanout: str = "gather"):
         self.cfg = cfg
         self.sync_period = sync_period
         self._workdir = workdir
@@ -126,7 +126,11 @@ class ClusterDriver:
         self.unverified = np.zeros(n_replicas, np.int64)
         self.stepped_down: set = set()
         self.R = n_replicas
-        self.cluster = SimCluster(cfg, n_replicas, group_size, mode=mode)
+        # fanout="psum" is the production full-connectivity
+        # configuration (O(W) fan-out); the default stays "gather" so
+        # tests can model partitions (see replica_step's docstring)
+        self.cluster = SimCluster(cfg, n_replicas, group_size, mode=mode,
+                                  fanout=fanout)
         self.timeout_cfg = timeout_cfg or TimeoutConfig()
         # failure detection / eviction (check_failure_count analog):
         # consecutive steps each member failed to ack the leader's window

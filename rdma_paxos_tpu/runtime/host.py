@@ -43,6 +43,12 @@ from rdma_paxos_tpu.parallel.mesh import (
     REPLICA_AXIS, build_spmd_step, stack_states)
 from rdma_paxos_tpu.utils.codec import bytes_to_words
 
+# per-replica scalar outputs extracted from a step/burst (ONE list so the
+# single-step and burst paths can never drift)
+OUT_KEYS = ("term", "role", "leader_id", "voted_term", "voted_for",
+            "head", "apply", "commit", "end", "hb_seen", "became_leader",
+            "acked", "accepted", "leadership_verified", "burst_hint")
+
 
 class HostReplicaDriver:
     """Per-host runtime for one replica of a multi-host group."""
@@ -168,13 +174,7 @@ class HostReplicaDriver:
         cfg, B = self.cfg, self.cfg.batch_slots
         data = np.zeros((B, cfg.slot_words), np.int32)
         meta = np.zeros((B, META_W), np.int32)
-        for i, (etype, conn, req, payload) in enumerate(batch[:B]):
-            data[i] = bytes_to_words(payload, cfg.slot_words)
-            meta[i, M_TYPE] = etype
-            meta[i, M_CONN] = conn
-            meta[i, M_REQID] = req
-            meta[i, M_LEN] = len(payload)
-            meta[i, M_GEN] = gen
+        self._pack_batch(batch, data, meta, gen)
         if peer_mask is not None and self._fanout == "psum":
             # the psum fan-out is sound only under full connectivity: a
             # partition mask could leave two self-claimed leaders whose
@@ -202,16 +202,26 @@ class HostReplicaDriver:
                 np.asarray(queue_depth, np.int32)),
         )
 
+    def _pack_batch(self, batch, data: np.ndarray, meta: np.ndarray,
+                    gen: int) -> None:
+        """Fill one [B, ...] data/meta pair from (etype, conn, req,
+        payload) rows — the single packing used by steps AND bursts."""
+        for i, (etype, conn, req, payload) in enumerate(
+                batch[:data.shape[0]]):
+            data[i] = bytes_to_words(payload, self.cfg.slot_words)
+            meta[i, M_TYPE] = etype
+            meta[i, M_CONN] = conn
+            meta[i, M_REQID] = req
+            meta[i, M_LEN] = len(payload)
+            meta[i, M_GEN] = gen
+
     def step(self, **kw) -> Dict[str, np.ndarray]:
         """One collective protocol step; every host must call this in the
         same loop iteration. Returns THIS replica's scalar outputs."""
         inp = self.make_input(**kw)
         self.state, out = self._step(self.state, inp)
         res = {}
-        for k in ("term", "role", "leader_id", "voted_term", "voted_for",
-                  "head", "apply", "commit",
-                  "end", "hb_seen", "became_leader", "acked", "accepted",
-                  "leadership_verified", "burst_hint"):
+        for k in OUT_KEYS:
             arr = getattr(out, k)
             local = [s for s in arr.addressable_shards
                      if s.index[0].start == self.me]
@@ -262,13 +272,7 @@ class HostReplicaDriver:
         meta = np.zeros((K, B, META_W), np.int32)
         count = np.zeros((K,), np.int32)
         for k, batch in enumerate(list(batches)[:K]):
-            for i, (etype, conn, req, payload) in enumerate(batch[:B]):
-                data[k, i] = bytes_to_words(payload, cfg.slot_words)
-                meta[k, i, M_TYPE] = etype
-                meta[k, i, M_CONN] = conn
-                meta[k, i, M_REQID] = req
-                meta[k, i, M_LEN] = len(payload)
-                meta[k, i, M_GEN] = gen
+            self._pack_batch(batch, data[k], meta[k], gen)
             count[k] = min(len(batch), B)
         fn = self._burst_fn()
         pm = self._global_from_local(np.ones(self.R, np.int32), fill=1)
@@ -278,10 +282,7 @@ class HostReplicaDriver:
                               self._kglobal(meta), self._kglobal(count),
                               pm, ap, qd)
         res = {}
-        for k in ("term", "role", "leader_id", "voted_term", "voted_for",
-                  "head", "apply", "commit", "end", "hb_seen",
-                  "became_leader", "acked", "accepted",
-                  "leadership_verified", "burst_hint"):
+        for k in OUT_KEYS:
             arr = getattr(outs, k)            # [K, R, ...]
             local = [s for s in arr.addressable_shards
                      if s.index[1].start == self.me]

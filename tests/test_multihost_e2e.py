@@ -93,13 +93,21 @@ def _boot_nodes(wd, iterations=20000, extra_env=None):
             env=e, cwd="/root/repo",
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
     leader, deadline = -1, time.time() + 90
-    while leader < 0 and time.time() < deadline:
-        for r in range(3):
-            p = os.path.join(wd, f"replica{r}.log")
-            if os.path.exists(p) and "] LEADER" in open(p).read():
-                leader = r
-        time.sleep(0.3)
-    assert leader >= 0, "no leader line found"
+    try:
+        while leader < 0 and time.time() < deadline:
+            for r in range(3):
+                p = os.path.join(wd, f"replica{r}.log")
+                if os.path.exists(p) and "] LEADER" in open(p).read():
+                    leader = r
+            time.sleep(0.3)
+        assert leader >= 0, "no leader line found"
+    except BaseException:
+        # never leak three daemons (and their orphaned toyservers)
+        # into the rest of the session on a failed boot
+        for p in procs:
+            p.kill()
+            p.wait()
+        raise
     return procs, leader, ports
 
 
