@@ -53,7 +53,7 @@ def main():
     ap.add_argument("--requests", type=int, default=2000)
     ap.add_argument("--clients", type=int, default=4)
     ap.add_argument("--port-base", type=int, default=7600)
-    ap.add_argument("--period", type=float, default=0.0005)
+    ap.add_argument("--period", type=float, default=0.02)
     ap.add_argument("--pipeline", type=int, default=1,
                     help="commands per client batch (redis-benchmark -P)")
     ap.add_argument("--threaded-app", action="store_true",
@@ -67,6 +67,9 @@ def main():
 
     os.environ.setdefault(
         "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/rp_jax_cache")
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
+                          "0.2")
     import jax
     if os.environ.get("RP_BENCH_CPU", "1") == "1":
         jax.config.update("jax_platforms", "cpu")
@@ -74,8 +77,8 @@ def main():
     from rdma_paxos_tpu.config import LogConfig, TimeoutConfig
     from rdma_paxos_tpu.runtime.driver import ClusterDriver
 
-    cfg = LogConfig(n_slots=2048, slot_bytes=512, window_slots=64,
-                    batch_slots=64)
+    cfg = LogConfig(n_slots=2048, slot_bytes=512, window_slots=256,
+                    batch_slots=256)
     ports = [args.port_base + i for i in range(args.replicas)]
     wd = tempfile.mkdtemp(prefix="rp_bench_")
     subprocess.run(["make", "-C", NATIVE], check=True, capture_output=True)
@@ -83,7 +86,10 @@ def main():
     driver = ClusterDriver(
         cfg, args.replicas, workdir=wd, app_ports=ports,
         timeout_cfg=TimeoutConfig(elec_timeout_low=0.5,
-                                  elec_timeout_high=1.0))
+                                  elec_timeout_high=1.0),
+        fanout="psum")
+    print("prewarming step/burst compiles...")
+    driver.prewarm()
     apps = []
     for r, port in enumerate(ports):
         env = dict(os.environ)
