@@ -218,8 +218,10 @@ int64_t ss_dump(void* h, void* out, uint64_t cap) {
 
 // Append every record of a dump produced by ss_dump (joiner side). A
 // headered dump's base is adopted IF this store is empty (the reset +
-// load path); the records follow. Returns records loaded, or -1 on
-// malformed input.
+// load path); loading a based dump into a non-empty or already-based
+// store is refused (-1) — appending those records would misalign the
+// absolute indexing ss_read/replay depend on. Returns records loaded,
+// or -1 on malformed input / base conflict.
 int64_t ss_load(void* h, const void* buf, uint64_t len) {
   auto* s = static_cast<Store*>(h);
   const char* p = static_cast<const char*>(buf);
@@ -231,7 +233,8 @@ int64_t ss_load(void* h, const void* buf, uint64_t len) {
     if (magic == kMagic) {
       off = 16;
       std::lock_guard<std::mutex> lk(s->mu);
-      if (s->offsets.empty() && s->base == 0 && base != 0) {
+      if (base != 0) {
+        if (!s->offsets.empty() || s->base != 0) return -1;
         uint64_t hdr[2] = {kMagic, base};
         if (pwrite(s->fd, hdr, 16, 0) != 16) return -1;
         if (s->data_start == 0) {

@@ -102,6 +102,11 @@ static void handle_line(int fd, char* line) {
   } else if (sscanf(line, "DEL %63s", k) == 1) {
     kv_del(k);
     snprintf(out, sizeof out, "+OK\n");
+  } else if (sscanf(line, "ECHO %255s", v) == 1) {
+    /* request/response no-op: the reply embeds the caller's token, so a
+     * barrier probe can identify its own response among buffered
+     * replies to earlier pipelined commands */
+    snprintf(out, sizeof out, "=%s\n", v);
   } else if (!strncmp(line, "COUNT", 5)) {
     snprintf(out, sizeof out, "%d\n", nkv);
   } else if (!strncmp(line, "DUMPALL", 7)) {

@@ -463,10 +463,18 @@ def replica_step(
                      jnp.maximum(m_wstart - 1, state.commit), end3)
 
     # follower commit/head riding the message (lazy, one step behind the
-    # leader's scan — matching the reference's lazy commit push)
+    # leader's scan — matching the reference's lazy commit push). The
+    # advance is CLAMPED to W per step: the committed-config checkpoint
+    # (Phase G) scans only the W-entry commit-crossing window, so an
+    # unbounded jump (rejoiner with a long matching log but stale
+    # commit) could carry a CONFIG entry past the scan unseen. W per
+    # step is also the host's apply/replay catch-up rate, so the clamp
+    # costs no end-to-end liveness.
     commit1 = jnp.where(
         can_absorb & ~i_lead2,
-        jnp.maximum(state.commit, jnp.minimum(m_scal[S_COMMIT], end3)),
+        jnp.maximum(state.commit,
+                    jnp.minimum(jnp.minimum(m_scal[S_COMMIT], end3),
+                                state.commit + W)),
         state.commit)
     head1 = jnp.where(
         can_absorb,

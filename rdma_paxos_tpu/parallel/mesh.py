@@ -113,9 +113,14 @@ def build_sim_burst(cfg: LogConfig, n_replicas: int, *,
         axis_name=REPLICA_AXIS, use_pallas=use_pallas, interpret=interpret,
         fanout=fanout, elections=False)
     vstep = jax.vmap(core, in_axes=(0, 0), axis_name=REPLICA_AXIS)
-    zeros_r = jnp.zeros((n_replicas,), jnp.int32)
 
     def burst(state_b, datas, metas, counts, peer_mask, applied, qdepth):
+        # NOTE: created in-trace, NOT closure-captured — a captured jnp
+        # array becomes a lifted executable constant, and on the
+        # tunneled TPU backend any program carrying lifted constants
+        # pays a flat ~100 ms per dispatch (measured round 5; it was
+        # round 4's entire "dispatch floor")
+        zeros_r = jnp.zeros((n_replicas,), jnp.int32)
         # datas [K, R, B, sw]; metas [K, R, B, MW]; counts [K, R];
         # applied [R] = the HOST's true apply cursors, frozen across the
         # burst — echoing st.commit here would let pressure-gated (and

@@ -30,6 +30,23 @@ from rdma_paxos_tpu.consensus.log import EntryType
 
 OP_HELLO, OP_CONNECT, OP_SEND, OP_CLOSE = 1, 2, 3, 4
 
+
+def spec_send_refused_dirty(etype: int, conn_id: int, replicated_conns,
+                            proxy, app_dirty: bool) -> bool:
+    """Shared intake-refusal quarantine policy (single source for BOTH
+    runtimes — ClusterDriver and NodeDaemon — so they cannot drift).
+
+    True iff refusing this event with -1 leaves a SPECULATIVE app
+    diverged: the shim already delivered a SEND's bytes to the app
+    (read() returns before the verdict), so a refused SEND on a
+    replicated session means the app executed input that will never
+    commit — the caller must set ``app_dirty`` before severing, exactly
+    as failing in-flight events does."""
+    return (etype == int(EntryType.SEND)
+            and conn_id in replicated_conns
+            and proxy is not None
+            and proxy.spec_mode and not app_dirty)
+
 _OP_TO_ETYPE = {
     OP_CONNECT: EntryType.CONNECT,
     OP_SEND: EntryType.SEND,
@@ -326,6 +343,91 @@ class ReplayEngine:
                 s.close()
             except OSError:
                 pass
+
+    def barrier(self, probe_fn, timeout: float = 10.0) -> None:
+        """PROCESSED-INPUT barrier: replay input is delivered over
+        per-connection sockets asynchronously, so a single-threaded
+        event-loop app may service a later out-of-band connection (e.g.
+        a checkpoint dump) before draining replay bytes still buffered
+        on other connections. ``probe_fn(sock)`` must issue a
+        request/response roundtrip on ``sock`` and return only once it
+        has observed the response to ITS OWN request (discarding any
+        buffered responses to earlier replayed commands). A reply on a
+        connection proves the app consumed every byte written to that
+        connection before the probe (TCP ordering + in-order reads), so
+        probing every replay connection proves all delivered records
+        were consumed."""
+        for s in list(self.conns.values()):
+            s.settimeout(timeout)
+            try:
+                probe_fn(s)
+            finally:
+                s.settimeout(None)
+
+    def quiesce(self, timeout: float = 5.0,
+                settle_rounds: int = 3) -> bool:
+        """Best-effort app-agnostic barrier (used when no probe hook is
+        configured): wait until every replay connection's bytes have
+        left BOTH kernel queues — our unsent send queue (TIOCOUTQ) and
+        the app-side receive queue (via /proc/net/tcp rx_queue for the
+        loopback peer socket) — over ``settle_rounds`` consecutive
+        samples. NARROWS but does NOT close the race: bytes the app has
+        read() into userspace buffers (or lines applied one at a time
+        between lock releases) are invisible to kernel queues, so a
+        checkpoint can still observe partially-applied input. Apps that
+        can express a request/response no-op should supply the
+        app_snapshot probe_fn, which is exact. Returns True if
+        quiescent, False on timeout."""
+        import fcntl
+        import struct
+        import termios
+        import time as _time
+        deadline = _time.monotonic() + timeout
+        app_port = self.addr[1]
+        quiet = 0
+        while True:
+            busy = False
+            ports = {}
+            for s in list(self.conns.values()):
+                try:
+                    out = struct.unpack(
+                        "i", fcntl.ioctl(s.fileno(), termios.TIOCOUTQ,
+                                         b"\x00" * 4))[0]
+                except OSError:
+                    out = 0
+                if out:
+                    busy = True
+                    break
+                try:
+                    ports[s.getsockname()[1]] = True
+                except OSError:
+                    pass
+            if not busy and ports:
+                # peer (app-side) sockets: local == app port, remote ==
+                # one of our replay ports; rx_queue is hex field 4 after
+                # the colon in /proc/net/tcp
+                try:
+                    with open("/proc/net/tcp") as f:
+                        for ln in f.readlines()[1:]:
+                            parts = ln.split()
+                            lport = int(parts[1].split(":")[1], 16)
+                            rport = int(parts[2].split(":")[1], 16)
+                            if lport == app_port and rport in ports:
+                                rxq = int(parts[4].split(":")[1], 16)
+                                if rxq:
+                                    busy = True
+                                    break
+                except (OSError, IndexError, ValueError):
+                    pass  # /proc unavailable: fall through on send-q only
+            if not busy:
+                quiet += 1
+                if quiet >= settle_rounds:
+                    return True
+            else:
+                quiet = 0
+            if _time.monotonic() >= deadline:
+                return False
+            _time.sleep(0.002)
 
     def drain_responses(self) -> None:
         """The local app writes responses to replayed connections; nobody

@@ -33,7 +33,8 @@ from rdma_paxos_tpu.consensus.membership import MembershipManager
 from rdma_paxos_tpu.consensus.snapshot import (
     install_snapshot, recover_vote, take_snapshot)
 from rdma_paxos_tpu.consensus.state import ConfigState, Role
-from rdma_paxos_tpu.proxy.proxy import PendingEvent, ProxyServer, ReplayEngine
+from rdma_paxos_tpu.proxy.proxy import (
+    PendingEvent, ProxyServer, ReplayEngine, spec_send_refused_dirty)
 from rdma_paxos_tpu.proxy.stablestore import (
     HardState, StableStore, atomic_write)
 from rdma_paxos_tpu.runtime.sim import SimCluster
@@ -103,15 +104,21 @@ class ClusterDriver:
         self.cfg = cfg
         self.sync_period = sync_period
         self._workdir = workdir
-        # bounded recovery: optional app-level snapshot hook pair
-        # (dump_fn(sock)->bytes, restore_fn(sock, blob)) speaking the
-        # app's own protocol over a passthrough connection. With it,
-        # checkpoint_app() captures a follower's app state at a known
-        # store index and COMPACTS the store prefix it covers, so donor
-        # transfer and fresh-app rebuild become O(app state + suffix)
-        # instead of O(entire history) — exceeding the reference, whose
-        # snapshot is always the full BDB record stream
-        # (db-interface.c:98-134).
+        # bounded recovery: optional app-level snapshot hook tuple
+        # (dump_fn(sock)->bytes, restore_fn(sock, blob)[, probe_fn(sock)])
+        # speaking the app's own protocol over a passthrough connection.
+        # With it, checkpoint_app() captures a follower's app state at a
+        # known store index and COMPACTS the store prefix it covers, so
+        # donor transfer and fresh-app rebuild become O(app state +
+        # suffix) instead of O(entire history) — exceeding the
+        # reference, whose snapshot is always the full BDB record stream
+        # (db-interface.c:98-134). probe_fn is the EXACT processed-input
+        # barrier (request/response roundtrip on a replay connection,
+        # returning once its own reply is observed); without it the
+        # checkpoint falls back to kernel-queue quiescence, which can
+        # still race an app that parks bytes in userspace buffers — see
+        # ReplayEngine.quiesce. Supply probe_fn whenever the app's
+        # protocol allows one.
         self.app_snapshot = app_snapshot
         self._ckpt_req: Optional[Tuple[int, threading.Event, list]] = None
         # lost-majority step-down (the reference leader SUICIDES after
@@ -190,11 +197,25 @@ class ClusterDriver:
             """Returns None (pass through), an int status (<0 severs the
             connection), or a PendingEvent (block until committed)."""
             with self._lock:
+                rt = self.runtimes[r]
+
+                def refuse_send():
+                    """Refuse with -1, quarantining a speculative app
+                    whose delivered bytes this refusal strands (shared
+                    policy: proxy.spec_send_refused_dirty)."""
+                    if spec_send_refused_dirty(
+                            etype, conn_id, rt.replicated_conns,
+                            rt.proxy, rt.app_dirty):
+                        rt.app_dirty = True
+                        rt.log.info_wtime(
+                            "APP DIRTY: speculated SEND refused at "
+                            "intake (conn %d)" % conn_id)
+                    return -1
+
                 if self.loop_error is not None or self._stop.is_set():
                     # no poll loop will ever release a commit wait: fail
                     # fast so the app severs and the client retries
-                    return -1
-                rt = self.runtimes[r]
+                    return refuse_send()
                 if etype == int(EntryType.CONNECT):
                     # our own replay connections (recognized by peer port)
                     # stay local; so do client connections on non-leaders
@@ -228,8 +249,9 @@ class ClusterDriver:
                 elif r in self.stepped_down:
                     # lost-majority step-down: refuse replicated service
                     # (a commit wait could never complete)
+                    status = refuse_send()
                     rt.replicated_conns.discard(conn_id)
-                    return -1
+                    return status
                 elif rt.app_dirty:
                     # a surviving replicated session on a replica whose
                     # app diverged (mis-speculation) must be severed
@@ -245,7 +267,7 @@ class ClusterDriver:
                     if etype == int(EntryType.CLOSE):
                         rt.replicated_conns.discard(conn_id)
                         return None
-                    return -1
+                    return refuse_send()
                 if etype == int(EntryType.CLOSE):
                     rt.replicated_conns.discard(conn_id)
                 frags = (fragment(payload, self.cfg.slot_bytes)
@@ -636,11 +658,24 @@ class ClusterDriver:
                 "leader's app state runs ahead of commit")
         if rt.app_dirty:
             raise RuntimeError("cannot checkpoint a dirty app")
-        dump_fn, _ = self.app_snapshot
-        # the app has executed exactly store[base, n): _apply_new_entries
-        # feeds the store and the app in the same sweep, and nothing
-        # advances between poll-loop control requests and the next sweep
+        dump_fn = self.app_snapshot[0]
+        probe_fn = (self.app_snapshot[2]
+                    if len(self.app_snapshot) > 2 else None)
+        # store[base, n) has been DELIVERED to the app's replay sockets
+        # by the time we run (same poll-loop sweep), but delivery is not
+        # consumption: a single-threaded event-loop app may service the
+        # dump connection before draining replay bytes buffered on
+        # other connections, and compact(n) would then drop records the
+        # checkpoint does not cover. Barrier first: a protocol probe per
+        # replay connection when the hook provides one, else kernel
+        # queue quiescence (send-q + app rx-q empty).
         n = len(rt.store)
+        if probe_fn is not None:
+            rt.replay.barrier(probe_fn)
+        elif not rt.replay.quiesce():
+            raise RuntimeError(
+                "app did not consume its replay stream (quiesce "
+                "timeout); checkpoint aborted to protect compaction")
         with rt.replay.raw_conn() as s:
             blob = dump_fn(s)
         path = self._ckpt_path(r)
@@ -651,7 +686,7 @@ class ClusterDriver:
             "compacted" % (n, len(blob)))
 
     def _restore_ckpt(self, rt: _ReplicaRuntime, ckpt) -> None:
-        _, restore_fn = self.app_snapshot
+        restore_fn = self.app_snapshot[1]
         with rt.replay.raw_conn() as s:
             restore_fn(s, ckpt[1])
 

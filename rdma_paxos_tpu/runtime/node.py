@@ -32,7 +32,8 @@ from rdma_paxos_tpu.config import ClusterConfig, LogConfig, TimeoutConfig
 from rdma_paxos_tpu.consensus.log import (
     EntryType, M_CONN, M_GEN, M_LEN, M_REQID, M_TYPE)
 from rdma_paxos_tpu.consensus.state import Role
-from rdma_paxos_tpu.proxy.proxy import PendingEvent, ProxyServer, ReplayEngine
+from rdma_paxos_tpu.proxy.proxy import (
+    PendingEvent, ProxyServer, ReplayEngine, spec_send_refused_dirty)
 from rdma_paxos_tpu.proxy.stablestore import HardState, StableStore
 from rdma_paxos_tpu.runtime.host import HostReplicaDriver
 from rdma_paxos_tpu.runtime.timers import ElectionTimer
@@ -126,7 +127,19 @@ class NodeDaemon:
         burst engagement is part of the collective program schedule).
         Measured on the 1-core CPU harness: 2000-SET drain 0.14 s
         without bursts vs 0.62 s with (the collective count is the
-        bottleneck there, not dispatches)."""
+        bottleneck there, not dispatches).
+
+        Bursts additionally REQUIRE full connectivity: K is agreed via
+        the gathered burst_hint (a max over the leaders each replica
+        heard), so an asymmetric peer_mask lets hosts disagree on K and
+        call different collective programs — a distributed hang, not a
+        clean failure. psum fan-out is the full-connectivity
+        configuration (HostReplicaDriver.step refuses psum with any
+        masked peer), so bursts are gated on it; under fanout='gather'
+        (the partition-simulation mode) bursts stay off regardless of
+        backend or RP_BURST."""
+        if self.hd._fanout != "psum":
+            return False
         env = os.environ.get("RP_BURST")
         if env is not None:
             return env == "1"
@@ -176,6 +189,16 @@ class NodeDaemon:
                 if etype == int(EntryType.CLOSE):
                     self.replicated_conns.discard(conn_id)
                     return None
+                # refusal strands bytes a speculative app already
+                # executed: quarantine (shared policy with ClusterDriver
+                # — proxy.spec_send_refused_dirty)
+                if spec_send_refused_dirty(etype, conn_id,
+                                           self.replicated_conns,
+                                           self.proxy, self.app_dirty):
+                    self.app_dirty = True
+                    self.log.info_wtime(
+                        "APP DIRTY: speculated SEND refused at intake "
+                        "(conn %d)" % conn_id)
                 return -1
             if etype == int(EntryType.CLOSE):
                 self.replicated_conns.discard(conn_id)

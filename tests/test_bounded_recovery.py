@@ -55,6 +55,22 @@ def toy_restore(sock, blob: bytes) -> None:
         assert f.readline().strip() == b"+OK"
 
 
+def toy_probe(sock) -> None:
+    """Processed-input barrier probe: ECHO a unique token and wait for
+    its reply, discarding buffered responses to earlier replayed
+    commands (see ReplayEngine.barrier)."""
+    import uuid
+    tok = uuid.uuid4().hex.encode()
+    sock.sendall(b"ECHO " + tok + b"\n")
+    buf = b""
+    want = b"=" + tok
+    while want not in buf:
+        chunk = sock.recv(65536)
+        if not chunk:
+            raise OSError("app closed during barrier probe")
+        buf += chunk
+
+
 def spawn_app(tmp_path, r, port):
     env = dict(os.environ)
     env["LD_PRELOAD"] = os.path.join(NATIVE, "interpose.so")
@@ -102,7 +118,7 @@ def test_checkpoint_compaction_keeps_rejoin_cost_flat(tmp_path):
             CFG, 3, workdir=str(tmp_path), app_ports=PORTS,
             timeout_cfg=TimeoutConfig(elec_timeout_low=0.4,
                                       elec_timeout_high=0.8),
-            app_snapshot=(toy_dump, toy_restore))
+            app_snapshot=(toy_dump, toy_restore, toy_probe))
         for r, port in enumerate(PORTS):
             apps.append(spawn_app(tmp_path, r, port))
         time.sleep(0.3)

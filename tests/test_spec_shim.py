@@ -202,3 +202,42 @@ def test_misspeculation_quarantine_and_reset(stack):
     assert cw.cmd("SET after reset-ok") == b"+OK"
     cw.close()
     assert wait_kv(PORTS[lead], "after", b"reset-ok") == b"reset-ok"
+
+
+def test_refused_send_at_intake_quarantines_spec_app(stack):
+    """A deposed leader with NO in-flight events is clean — but a
+    surviving pre-deposition session that sends AFTER deposition has its
+    bytes executed by the speculative app before intake refuses them
+    (-1). That refusal must quarantine the app exactly like failing
+    in-flight events does: otherwise the diverged app keeps serving
+    stale local reads and serves clients again on re-election."""
+    driver, _apps, _tmp = stack
+    lead = driver.leader()
+
+    c = Client(PORTS[lead])
+    assert c.cmd("SET durable yes") == b"+OK"     # commits; inflight drains
+
+    # depose the leader: partition it away, let the majority elect, heal
+    driver.cluster.partition([[lead], [r for r in range(3) if r != lead]])
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        nl = driver.leader()
+        if nl >= 0 and nl != lead:
+            break
+        time.sleep(0.05)
+    assert driver.leader() != lead, "no failover"
+    driver.cluster.heal()
+    time.sleep(0.3)   # a few poll iterations under the healed mesh
+    # no in-flight input was lost, so deposition alone leaves it clean
+    assert not driver.runtimes[lead].app_dirty
+
+    # the surviving session sends: spec app consumes, intake refuses
+    c.send_only("SET sneaky bad")
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if driver.runtimes[lead].app_dirty:
+            break
+        time.sleep(0.05)
+    assert driver.runtimes[lead].app_dirty, (
+        "refused-at-intake speculated SEND did not quarantine the app")
+    c.close()

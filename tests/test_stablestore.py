@@ -110,3 +110,31 @@ def test_compacted_dump_carries_base(tmp_path):
         assert dst.read(7) == b"e7"
         with pytest.raises(IndexError):
             dst.read(4)
+
+
+def test_load_based_dump_into_nonempty_store_refused(tmp_path):
+    """Loading a compacted (based) dump into a non-empty or already-
+    based store would append its records at the wrong absolute indices,
+    silently misaligning ss_read/replay — the C API must refuse (-1)
+    rather than corrupt (Python callers reset() first, but the binding
+    is not the only possible caller)."""
+    src_p = str(tmp_path / "src3.db")
+    with StableStore(src_p) as src:
+        for i in range(8):
+            src.append(b"e%d" % i)
+        src.compact(5)
+        blob = src.dump()
+    # non-empty destination: refuse
+    with StableStore(str(tmp_path / "dst3.db")) as dst:
+        dst.append(b"pre-existing")
+        with pytest.raises(OSError):
+            dst.load(blob)
+        assert len(dst) == 1               # nothing was appended
+        assert dst.read(0) == b"pre-existing"
+    # already-based destination: refuse too
+    with StableStore(str(tmp_path / "dst4.db")) as dst:
+        dst.reset()
+        assert dst.load(blob) == 3         # first load adopts base 5
+        with pytest.raises(OSError):
+            dst.load(blob)                 # second load must not stack
+        assert len(dst) == 8
