@@ -177,55 +177,123 @@ def measure(cfg: LogConfig, batch: int, iters: int = 400,
         q.popleft().block_until_ready()
     pipe = _pcts(intervals)
 
-    # scan mode: amortized per-step latency; throughput from the REAL
-    # commit advance (the ring's capacity clamp may throttle below
-    # batch/step — never assume)
+    # scan mode: amortized per-step device latency, honest protocol for
+    # the relay-tunneled backend: (1) NO host value reads before this
+    # point (the first read permanently exits speculative dispatch
+    # pipelining); (2) block_until_ready is OPTIMISTIC under that
+    # speculation, so the timed region ENDS WITH the commit read, which
+    # forces the real device drain. One aggregate region; the single
+    # ~100 ms RTT the read adds is amortized over reps*K_SCAN steps.
     state2 = stack_states(cfg, R, R)
     state2 = elect(state2, *consts)
-    state2, cs = scan_k(state2, *consts)          # compile
-    jax.block_until_ready(cs)
-    c0 = int(np.asarray(state2.commit[0]))
+    # compile WITHOUT executing (an executed warmup scan could still be
+    # un-drained when the timer starts — block_until_ready is
+    # optimistic here — and its device time would bleed into dt)
+    scan_c = scan_k.lower(state2, *consts).compile()
+    state_pre = state2
+    reps = 8
     t0 = time.perf_counter()
-    reps = 4
     for _ in range(reps):
-        state2, cs = scan_k(state2, *consts)
-    jax.block_until_ready(cs)
-    dt = time.perf_counter() - t0
-    per_step_us = dt / (reps * K_SCAN) * 1e6
-    committed = int(np.asarray(state2.commit[0])) - c0
+        state2, cs = scan_c(state2, *consts)
+    final = int(np.asarray(state2.commit[0]))     # timed: forces drain
+    scan_dt = time.perf_counter() - t0
+    per_step_us = scan_dt / (reps * K_SCAN) * 1e6
+    committed = final - int(np.asarray(state_pre.commit[0]))
+
+    # honest host-visible number: one step PLUS reading its commit back
+    # (the mode a per-step-readback driver lives in on this tunnel; on a
+    # directly-attached TPU host D2H is µs-scale and this converges to
+    # the dispatch row)
+    rb = []
+    st3, c3 = one(state2, *consts)
+    for _ in range(20):
+        t0 = time.perf_counter()
+        st3, c3 = one(st3, *consts)
+        _ = int(np.asarray(c3))
+        rb.append(time.perf_counter() - t0)
+    rb.sort()
+
     return dict(batch=batch, dispatch=disp,
                 pipelined=dict(depth=pipeline_depth, **pipe),
                 scan_step_us=float(per_step_us),
-                commit_throughput_scan=float(committed / dt))
+                commit_throughput_scan=float(committed / scan_dt),
+                step_plus_readback_ms_p50=float(rb[len(rb) // 2] * 1e3))
+
+
+# the three measured profiles: latency geometry at batch 1 and 8, and
+# the throughput geometry the redis bench drives
+ROWS = {
+    "1": (dict(n_slots=256, slot_bytes=64, window_slots=16,
+               batch_slots=8), 1),
+    "8": (dict(n_slots=256, slot_bytes=64, window_slots=16,
+               batch_slots=8), 8),
+    "64": (dict(n_slots=256, slot_bytes=64, window_slots=64,
+                batch_slots=64), 64),
+}
 
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--json", default=None)
     ap.add_argument("--iters", type=int, default=400)
+    # internal: run ONE row and print its JSON (each row runs in a
+    # fresh process — on the tunneled backend, dispatch latency of a
+    # program degrades once unrelated large executables accumulate in
+    # the same process, so rows must not share one)
+    ap.add_argument("--row", default=None,
+                    choices=list(ROWS) + ["bare"])
     args = ap.parse_args()
 
-    bare = measure_bare(args.iters)
-    # latency profile: small ring/window/batch (gather and scatter cost
-    # scales with rows; the reference's production profile likewise
-    # shrinks its cadence for latency, target/nodes.local.cfg:23-28).
-    # Throughput profile: the geometry the redis bench drives.
-    lat_cfg = LogConfig(n_slots=256, slot_bytes=64, window_slots=16,
-                        batch_slots=8)
-    thr_cfg = LogConfig(n_slots=256, slot_bytes=64, window_slots=64,
-                        batch_slots=64)
-    rows = [measure(lat_cfg, 1, args.iters),
-            measure(lat_cfg, 8, args.iters),
-            measure(thr_cfg, 64, args.iters)]
-    for row, c in zip(rows, (lat_cfg, lat_cfg, thr_cfg)):
-        row["config"] = dict(n_slots=c.n_slots, slot_bytes=c.slot_bytes,
-                             window_slots=c.window_slots,
-                             batch_slots=c.batch_slots)
+    if args.row is not None:
+        if args.row == "bare":
+            row = measure_bare(args.iters)
+        else:
+            cfg_kw, batch = ROWS[args.row]
+            row = measure(LogConfig(**cfg_kw), batch, args.iters)
+            row["config"] = cfg_kw
+        row["backend"] = jax.default_backend()
+        print("ROWJSON:" + json.dumps(row))
+        return
+
+    # the parent NEVER touches the device: a parent-held TPU client
+    # time-slices the tunneled chip against the row subprocesses and
+    # poisons their numbers
+    import subprocess
+
+    def run_row(key):
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--row", key, "--iters", str(args.iters)],
+            capture_output=True, text=True)
+        for ln in proc.stdout.splitlines():
+            if ln.startswith("ROWJSON:"):
+                return json.loads(ln[len("ROWJSON:"):])
+        raise RuntimeError("row %s failed: %s" % (key,
+                                                  proc.stderr[-2000:]))
+
+    bare = run_row("bare")
+    backend = bare.pop("backend")
+    rows = [run_row(key) for key in ROWS]
+    for r in rows:
+        r.pop("backend", None)
     out = dict(
         metric="commit_latency_frontier",
-        backend=jax.default_backend(),
+        backend=backend,
         replicas=R,
         target_p99_us=50.0,
+        methodology=(
+            "Relay-tunneled backend: the tunnel speculates pure dispatch "
+            "streams (block_until_ready is optimistic) and the first "
+            "device->host VALUE read permanently drops the process to "
+            "~100ms synchronous dispatches. 'dispatch'/'pipelined' rows "
+            "time enqueue+optimistic-completion (the client-visible "
+            "latency on a directly-attached TPU host, where readback is "
+            "us-scale); 'scan_step_us' is true amortized device time "
+            "(timed region ends with a drain-forcing read); "
+            "'step_plus_readback_ms_p50' is the host-visible per-step "
+            "cost ON THIS TUNNEL when reading every step - it measures "
+            "the relay RTT, not the protocol. Each row runs in a fresh "
+            "process."),
         bare_dispatch=bare,
         batch1_vs_bare_p99=round(rows[0]["dispatch"]["p99_us"]
                                  / bare["p99_us"], 2),
