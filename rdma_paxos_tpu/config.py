@@ -46,6 +46,15 @@ class LogConfig:
     slot_bytes: int = 512        # payload bytes per slot (proxy fragments above)
     window_slots: int = 128      # max entries moved leader->followers per step
     batch_slots: int = 64        # max entries appended by the leader per step
+    # All log offsets (head/apply/commit/end, stamped M_GIDX) are i32
+    # entry indices, bounding an epoch at 2^31-1 entries. When any end
+    # offset crosses this threshold the runtime performs a COORDINATED
+    # REBASE — every offset on every replica (and each host's apply
+    # cursor) drops by the minimum head, restoring headroom with no
+    # visible effect (the reference is immune via u64 byte offsets,
+    # dare_log.h:77-103; we renumber instead of widening, keeping i32
+    # arithmetic on the VPU). Tests shrink it to cross the boundary.
+    rebase_threshold: int = 1 << 30
 
     def __post_init__(self) -> None:
         if self.n_slots & (self.n_slots - 1):
@@ -56,6 +65,14 @@ class LogConfig:
             raise ValueError("window_slots must be <= n_slots")
         if self.batch_slots > self.window_slots:
             raise ValueError("batch_slots must be <= window_slots")
+        if self.rebase_threshold <= self.n_slots:
+            raise ValueError("rebase_threshold must exceed n_slots")
+        # end may run ahead of the threshold by up to the ring capacity
+        # before the rollover lands; leave that headroom below I32_MAX
+        if self.rebase_threshold > (1 << 31) - 1 - 2 * self.n_slots:
+            raise ValueError(
+                "rebase_threshold too close to the i32 ceiling; leave "
+                ">= 2*n_slots of headroom")
 
     @property
     def slot_words(self) -> int:

@@ -24,7 +24,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from rdma_paxos_tpu.consensus.log import Log, M_TERM, META_W, slot_of
+from rdma_paxos_tpu.consensus.log import (
+    Log, M_GIDX, M_TERM, META_W, slot_of)
 from rdma_paxos_tpu.consensus.state import ReplicaState
 
 
@@ -118,6 +119,46 @@ def _install(state_b: ReplicaState, r, index, term, cur_term, voted_term,
                jnp.asarray(v).astype(getattr(state_b, k).dtype))
            for k, v in sets.items()}
     return dataclasses.replace(state_b, log=log, **out)
+
+
+@jax.jit
+def rebase_offsets(state_b: ReplicaState, delta) -> ReplicaState:
+    """Subtract ``delta`` from every log offset of every replica — the
+    coordinated i32-overflow rollover (LogConfig.rebase_threshold).
+
+    Offsets are RELATIVE quantities everywhere in the protocol (window
+    starts, acks, commit scans all compare offsets to each other), so a
+    uniform subtraction is invisible to consensus as long as (a) every
+    replica shifts in the same host iteration (the drivers guarantee
+    it: SimCluster shifts the whole batched state between steps;
+    NodeDaemon shifts collectively on a gathered, deterministic signal),
+    (b) ``delta <= min(head)`` so no live offset goes negative, and
+    (c) ``delta`` is a MULTIPLE OF n_slots — the slot of global index
+    ``g`` is ``g % n_slots`` and entries do not move, so the mapping
+    must be preserved (callers round the min head down).
+    The stamped M_GIDX column shifts too; a recycled slot's stale gidx
+    stays < head under uniform subtraction, so the liveness rule
+    ``gidx >= head`` is preserved. The reference needs no analog — its
+    u64 byte offsets outlive any deployment (dare_log.h:77-103).
+
+    Works on the vmap-batched state and (transparently, no collectives)
+    on a shard_map-sharded state: every operation is elementwise."""
+    i32 = jnp.int32
+    d = jnp.asarray(delta, i32)
+    sw = state_b.log.slot_words
+    gcol = sw + M_GIDX
+    buf = state_b.log.buf
+    buf = buf.at[..., gcol].add(-d)
+    return dataclasses.replace(
+        state_b,
+        log=Log(buf=buf),
+        head=state_b.head - d,
+        apply=state_b.apply - d,
+        commit=state_b.commit - d,
+        end=state_b.end - d,
+        cfg_src=jnp.where(state_b.cfg_src >= 0,
+                          state_b.cfg_src - d, state_b.cfg_src),
+    )
 
 
 def export_row(state_b: ReplicaState, r: int) -> dict:

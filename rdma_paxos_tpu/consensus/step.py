@@ -83,7 +83,7 @@ I32_MAX = jnp.iinfo(jnp.int32).max
 # collective-count coordination that lets multihost drivers dispatch
 # fused multi-step bursts without an extra gather)
 (C_TERM, C_ROLE, C_END, C_COMMIT, C_LTERM, C_APPLY, C_TMO,
- C_VTERM, C_VFOR, C_QDEP, C_N) = range(11)
+ C_VTERM, C_VFOR, C_QDEP, C_HEAD, C_N) = range(12)
 # window-message scalar columns
 S_VALID, S_WSTART, S_WCOUNT, S_TERM, S_PREV, S_COMMIT, S_HEAD, S_N = range(8)
 
@@ -138,6 +138,14 @@ class StepOutput:
                               # leader (identical on every host under full
                               # connectivity): hosts use it to agree on a
                               # fused multi-step burst size next iteration
+    rebase_delta: jax.Array   # >0 when any heard end crossed
+                              # cfg.rebase_threshold: the agreed uniform
+                              # offset subtraction (min member head) for
+                              # the coordinated i32 rollover. Identical
+                              # on every host under full connectivity —
+                              # NodeDaemon applies it collectively; the
+                              # in-process drivers use their omniscient
+                              # min-head instead (partition-safe).
 
 
 def make_step_input(cfg: LogConfig, n_replicas: int) -> StepInput:
@@ -261,6 +269,7 @@ def replica_step(
     ctrl = ctrl.at[C_VTERM].set(state.voted_term)
     ctrl = ctrl.at[C_VFOR].set(state.voted_for)
     ctrl = ctrl.at[C_QDEP].set(inp.queue_depth)
+    ctrl = ctrl.at[C_HEAD].set(state.head)
     allc = lax.all_gather(ctrl, axis_name)                  # [R, C_N]
 
     g_term, g_end = allc[:, C_TERM], allc[:, C_END]
@@ -713,6 +722,27 @@ def replica_step(
         burst_hint=jnp.max(jnp.where(
             heard & (allc[:, C_ROLE] == int(Role.LEADER)),
             allc[:, C_QDEP], 0)).astype(i32),
+        # coordinated i32-rollover signal: when any heard end crossed
+        # the threshold, the agreed subtraction is the min PRE-step head
+        # over ALL heard rows (every live offset stays >= 0), rounded
+        # DOWN to a multiple of n_slots (slot = g % n_slots and entries
+        # do not move, so the mapping must be preserved). The min is
+        # deliberately NOT filtered by membership: bitmask_new skews by
+        # one step during CONFIG adoption (leader adopts at append,
+        # followers at absorb), and a membership-filtered min would let
+        # hosts derive DIFFERENT deltas in that window — permanent
+        # offset divergence. ``heard`` is the only mask that is
+        # provably identical on every host under full connectivity; a
+        # catching-up row's low head merely defers the rollover.
+        rebase_delta=jnp.where(
+            jnp.max(jnp.where(heard, g_end, 0))
+            >= cfg.rebase_threshold,
+            jnp.maximum(
+                jnp.bitwise_and(
+                    jnp.min(jnp.where(heard, allc[:, C_HEAD], I32_MAX)),
+                    ~(cfg.n_slots - 1)),
+                0),
+            0).astype(i32),
     )
     return new_state, out
 

@@ -47,7 +47,8 @@ from rdma_paxos_tpu.utils.codec import bytes_to_words
 # single-step and burst paths can never drift)
 OUT_KEYS = ("term", "role", "leader_id", "voted_term", "voted_for",
             "head", "apply", "commit", "end", "hb_seen", "became_leader",
-            "acked", "accepted", "leadership_verified", "burst_hint")
+            "acked", "accepted", "leadership_verified", "burst_hint",
+            "rebase_delta")
 
 
 class HostReplicaDriver:
@@ -293,6 +294,17 @@ class HostReplicaDriver:
                    if s.index[1].start == self.me]
             res["accepted"] = np.asarray(acc[0].data[:, 0]).sum()
         return res
+
+    def rebase(self, delta: int) -> None:
+        """Apply the coordinated i32-offset rollover to this host's
+        sharded state (see ``consensus/snapshot.rebase_offsets``). The
+        program is purely elementwise — no collectives — so hosts may
+        apply it independently once they agree on ``delta`` (the step's
+        gathered ``rebase_delta`` output, identical on every host under
+        full connectivity)."""
+        from rdma_paxos_tpu.consensus.snapshot import rebase_offsets
+        self.state = rebase_offsets(
+            self.state, jnp.asarray(delta, jnp.int32))
 
     def export_local_row(self) -> dict:
         """THIS replica's full state row as host numpy (local shard reads

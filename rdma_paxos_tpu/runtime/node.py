@@ -112,6 +112,7 @@ class NodeDaemon:
         self.timer = ElectionTimer(timeout_cfg or TimeoutConfig(),
                                    seed=seed + process_id)
         self.last: Optional[Dict] = None
+        self._rebase_warned = False
 
     # single multihost burst tier (see iterate) — identical on all hosts
     BURST_K = 8
@@ -372,6 +373,33 @@ class NodeDaemon:
                 while self.inflight:
                     ev, _ = self.inflight.popleft()
                     ev.release(-1)
+        # coordinated i32-offset rollover: the gathered rebase_delta is
+        # identical on every host under full connectivity (psum fan-out
+        # — the only configuration this daemon bursts or rebases in), so
+        # every host applies the same subtraction in the same iteration.
+        # The rebase program itself is elementwise (no collectives), so
+        # no cross-host ordering hazard exists even in principle.
+        rd = res.get("rebase_delta")
+        if rd is not None and int(rd) > 0:
+            if self.hd._fanout == "psum":
+                delta = int(rd)
+                self.hd.rebase(delta)
+                self.applied -= delta
+                self.log.info_wtime(
+                    "REBASE: offsets dropped by %d (i32 rollover)"
+                    % delta)
+            elif not self._rebase_warned:
+                # under gather fan-out the gathered delta is NOT
+                # guaranteed identical across hosts (heard masks can
+                # differ), so applying it could diverge offsets — but
+                # silently discarding it would let the i32 ceiling
+                # arrive unannounced. Warn loudly, once.
+                self._rebase_warned = True
+                self.log.info_wtime(
+                    "WARNING: rebase_delta=%d ignored (fanout=%r is "
+                    "not full-connectivity); offsets are approaching "
+                    "the i32 ceiling with no rollover possible"
+                    % (int(rd), self.hd._fanout))
         self.last = res
         return res
 

@@ -102,6 +102,9 @@ class SimCluster:
         # slots must never reach the app — until snapshot recovery
         self.need_recovery: set = set()
         self._wedged: set = set()     # test hook: frozen apply (wedged app)
+        # coordinated i32-offset rollovers performed (see _maybe_rebase)
+        self.rebases = 0
+        self.rebased_total = 0
 
     # ---------------- client-side API ----------------
 
@@ -251,7 +254,8 @@ class SimCluster:
                for k in ("term", "role", "leader_id", "voted_term",
                          "voted_for", "head", "apply", "commit", "end",
                          "hb_seen", "became_leader", "acked",
-                         "peer_acked", "leadership_verified")}
+                         "peer_acked", "leadership_verified",
+                         "rebase_delta")}
         acc = np.asarray(outs.accepted).sum(axis=0)         # [R]
         res["accepted"] = acc
         # Shortfall: appends stop entirely the step the replica is not
@@ -268,6 +272,7 @@ class SimCluster:
                 if a < len(taken[r]):
                     self.pending[r] = taken[r][a:] + self.pending[r]
         self._replay_committed(res)
+        self._maybe_rebase(res)
         self.last = res
         return res
 
@@ -332,7 +337,7 @@ class SimCluster:
                          "voted_for", "head", "apply",
                          "commit", "end", "hb_seen", "became_leader",
                          "acked", "accepted", "peer_acked",
-                         "leadership_verified")}
+                         "leadership_verified", "rebase_delta")}
         # ring-full backpressure: entries the leader could not append are
         # requeued in order (submissions to non-leaders are dropped by
         # design — proxy submits on the leader only)
@@ -344,8 +349,46 @@ class SimCluster:
                 if acc < len(take):
                     self.pending[r] = take[acc:] + self.pending[r]
         self._replay_committed(res)
+        self._maybe_rebase(res)
         self.last = res
         return res
+
+    def _maybe_rebase(self, res) -> None:
+        """Coordinated i32-offset rollover (LogConfig.rebase_threshold):
+        when any end offset crosses the threshold, subtract the minimum
+        head from EVERY offset on every replica and from the host apply
+        cursors — invisible to the protocol (offsets are relative), and
+        it restores ~threshold entries of headroom. The in-process
+        driver is omniscient, so the min is over ALL replicas (not just
+        heard ones) — partition-safe: a partitioned laggard's low head
+        simply defers the rollover until it recovers or is evicted.
+        ``res`` is adjusted in place so callers observe post-rollover
+        offsets."""
+        if int(res["end"].max()) < self.cfg.rebase_threshold:
+            return
+        # the slot of global index g is g % n_slots and entries do NOT
+        # move: the subtraction must preserve the mapping, so the delta
+        # is the min head rounded DOWN to a multiple of n_slots. A
+        # replica already flagged need_recovery is EXCLUDED from the
+        # min: it stopped replaying (snapshot install renumbers it from
+        # the donor), and letting its frozen head pin the rollover
+        # would wedge the whole cluster at the i32 ceiling. Its offsets
+        # may go transiently negative — benign: the gap gate keeps it
+        # from absorbing windows until recovery overwrites them.
+        heads = [int(res["head"][r]) for r in range(self.R)
+                 if r not in self.need_recovery]
+        if not heads:
+            return
+        delta = min(heads) & ~(self.cfg.n_slots - 1)
+        if delta <= 0:
+            return
+        from rdma_paxos_tpu.consensus.snapshot import rebase_offsets
+        self.state = rebase_offsets(self.state, delta)
+        self.applied -= delta
+        for k in ("head", "apply", "commit", "end"):
+            res[k] = res[k] - delta
+        self.rebases += 1
+        self.rebased_total += delta
 
     def _replay_committed(self, res) -> None:
         """Host apply loop: fetch newly committed entries from the device
