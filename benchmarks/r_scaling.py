@@ -1,0 +1,130 @@
+#!/usr/bin/env python
+"""Per-replica step cost vs group size R, under shard_map at BENCH
+geometry — the flat-in-R evidence for ANALYSIS_R_SCALING.md.
+
+Every topology available in this environment executes all R replicas'
+device work on one execution unit (virtual CPU devices share one core),
+so total step time grows ~linearly with R; what the design controls —
+and what a real R-chip mesh runs per chip — is step time DIVIDED BY R.
+This driver measures exactly that, with the honest protocol (timed
+region ends with a value read), at the same geometry bench.py runs
+(n_slots=8192, slot_bytes=128, window=batch=2048), psum fan-out.
+
+    python benchmarks/r_scaling.py [--json out.json]
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def run_row(R: int, iters: int) -> dict:
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__),
+         "--row", str(R), "--iters", str(iters)],
+        capture_output=True, text=True)
+    for ln in proc.stdout.splitlines():
+        if ln.startswith("ROWJSON:"):
+            return json.loads(ln[len("ROWJSON:"):])
+    raise RuntimeError("R=%d failed: %s" % (R, proc.stderr[-2000:]))
+
+
+def measure(R: int, iters: int) -> dict:
+    os.environ["XLA_FLAGS"] = (
+        "--xla_force_host_platform_device_count=8")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import time
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from rdma_paxos_tpu.config import LogConfig
+    from rdma_paxos_tpu.consensus.log import (
+        EntryType, M_LEN, M_TYPE, META_W)
+    from rdma_paxos_tpu.consensus.step import StepInput
+    from rdma_paxos_tpu.parallel.mesh import (
+        build_spmd_burst, build_spmd_step, make_replica_mesh,
+        stack_states)
+
+    cfg = LogConfig(n_slots=8192, slot_bytes=128, window_slots=2048,
+                    batch_slots=2048)
+    mesh = make_replica_mesh(R)
+    shard = jax.sharding.NamedSharding(
+        mesh, jax.sharding.PartitionSpec("replica"))
+    kshard = jax.sharding.NamedSharding(
+        mesh, jax.sharding.PartitionSpec(None, "replica"))
+    B, K = cfg.batch_slots, 8
+    data = jax.device_put(
+        np.zeros((K, R, B, cfg.slot_words), np.int32), kshard)
+    meta_np = np.zeros((K, R, B, META_W), np.int32)
+    meta_np[:, :, :, M_TYPE] = int(EntryType.SEND)
+    meta_np[:, :, :, M_LEN] = 16
+    meta = jax.device_put(meta_np, kshard)
+    count = jax.device_put(np.full((K, R), B, np.int32), kshard)
+    peer = jax.device_put(np.ones((R, R), np.int32), shard)
+
+    step = build_spmd_step(cfg, R, mesh, fanout="psum", donate=False)
+    burst = build_spmd_burst(cfg, R, mesh, fanout="psum")
+    state = jax.device_put(stack_states(cfg, R, R), shard)
+    inp = StepInput(
+        batch_data=jax.device_put(
+            np.zeros((R, B, cfg.slot_words), np.int32), shard),
+        batch_meta=jax.device_put(
+            np.zeros((R, B, META_W), np.int32), shard),
+        batch_count=jax.device_put(np.zeros((R,), np.int32), shard),
+        timeout_fired=jax.device_put(
+            np.zeros((R,), np.int32).copy(), shard).at[0].set(1),
+        peer_mask=peer,
+        apply_done=jax.device_put(np.zeros((R,), np.int32), shard),
+        queue_depth=jax.device_put(np.zeros((R,), np.int32), shard))
+    state, _ = step(state, inp)            # election
+
+    applied = jax.device_put(np.zeros((R,), np.int32), shard)
+    qd = jax.device_put(np.zeros((R,), np.int32), shard)
+    state, outs = burst(state, data, meta, count, peer,
+                        applied, qd)       # warmup compile + run
+    jax.block_until_ready(outs.commit)
+    pre = int(np.asarray(state.commit)[0])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        applied = state.commit.copy()      # echo applies => pruning (copy:
+        # burst donates the state; the same buffer cannot also be an arg)
+        state, outs = burst(state, data, meta, count, peer,
+                            applied, qd)
+    final = int(np.asarray(state.commit)[0])   # forces drain (uniform
+    dt = time.perf_counter() - t0              # protocol w/ bench.py)
+    steps = iters * K
+    return dict(R=R, step_us=dt / steps * 1e6,
+                per_replica_us=dt / steps / R * 1e6,
+                committed=final - pre,
+                ops=float((final - pre) / dt))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--json", default=None)
+    ap.add_argument("--row", type=int, default=None)
+    ap.add_argument("--iters", type=int, default=6)
+    args = ap.parse_args()
+    if args.row is not None:
+        print("ROWJSON:" + json.dumps(measure(args.row, args.iters)))
+        return
+    rows = [run_row(R, args.iters) for R in (3, 5, 7)]
+    out = dict(metric="per_replica_step_cost_vs_R",
+               topology="shard_map over virtual CPU devices "
+                        "(one core!), bench geometry, psum fan-out",
+               rows=rows)
+    print(json.dumps(out, indent=2))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(out, f, indent=2)
+
+
+if __name__ == "__main__":
+    main()

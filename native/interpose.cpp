@@ -240,10 +240,52 @@ void advance_frontier_locked() {
   }
 }
 
+// Driver death — the SPECULATIVE output-commit discipline's hard case.
+// Replies held for input the dead driver never acked must NOT be
+// released: the input may never have committed, and releasing the
+// reply fabricates an ack for a write that is lost (the client would
+// hold an +OK for data no surviving replica has). So: flush only the
+// chunks the PRE-DEATH commit frontier already covers (their input
+// committed — releasing them is correct and avoids spurious client
+// retries), DROP the speculative remainder, and sever every tracked
+// connection so clients observe a reset — they retry against the new
+// leader/world, exactly as on a refused event. The app itself has
+// executed uncommitted input (diverged); its supervisor replaces it
+// with a store-rebuilt instance at the next generation. resp_mu held.
+void driver_death_locked() {
+  driver_dead = true;
+  proxy_fd = -1;
+  flush_outq_locked();                // committed-covered chunks only
+  if (outq) {
+    // speculative data replies are DROPPED; deferred is_close chunks
+    // must still run their real close (the fd was handed to us by the
+    // app's close() — dropping the chunk would leak it open with the
+    // client hanging instead of reset)
+    while (!outq->empty()) {
+      OutChunk c = std::move(outq->front());
+      outq->pop_front();
+      outq_bytes -= c.data.size();
+      bool gen_ok = c.fd >= 0 && c.fd < kMaxFd && fd_gen[c.fd] == c.gen;
+      if (gen_ok && c.is_close) {
+        fd_gen[c.fd]++;
+        real_close(c.fd);
+      }
+    }
+  }
+  for (int fd = 0; fd < kMaxFd; fd++) {
+    if (tracked[fd]) {
+      severed[fd] = 1;
+      tracked[fd] = 0;
+      shutdown(fd, SHUT_RDWR);
+    }
+  }
+  pthread_cond_broadcast(&resp_cv);
+}
+
 // Reader thread: distributes seq-tagged responses. EOF / error => the
-// driver died: stop interposing, release every waiter and all held
-// output (the app keeps serving unreplicated — same fallback as the
-// sync design, process-wide in one place).
+// driver died: every waiter is released with a refusal and all tracked
+// connections sever (see driver_death_locked — held speculative output
+// is dropped, never flushed).
 void* reader_main(void*) {
   for (;;) {
     uint8_t buf[8];
@@ -276,11 +318,7 @@ void* reader_main(void*) {
     pthread_mutex_unlock(&resp_mu);
   }
   pthread_mutex_lock(&resp_mu);
-  driver_dead = true;
-  proxy_fd = -1;                      // hooks pass through from now on
-  frontier = last_sent;               // release everything held
-  flush_outq_locked();
-  pthread_cond_broadcast(&resp_cv);
+  driver_death_locked();
   pthread_mutex_unlock(&resp_mu);
   return nullptr;
 }
@@ -343,16 +381,14 @@ int32_t proxy_call(uint8_t op, int32_t fd, const void* data, uint32_t len) {
   if (!ok) driver_dead = true;
   while (s.state != DONE && !driver_dead)
     pthread_cond_wait(&resp_cv, &resp_mu);
-  int32_t status = driver_dead ? 0 : s.status;
+  // death => REFUSE (the event's fate is unknown; the caller severs the
+  // connection so the client retries elsewhere — never a silent
+  // unreplicated pass-through)
+  int32_t status = driver_dead ? -1 : s.status;
   s.waited = false;                   // frontier may now pass this slot
   if (s.state != DONE) s.state = DONE;
   advance_frontier_locked();
-  if (driver_dead) {
-    proxy_fd = -1;
-    frontier = last_sent;
-    flush_outq_locked();
-    pthread_cond_broadcast(&resp_cv);
-  }
+  if (driver_dead) driver_death_locked();
   pthread_mutex_unlock(&resp_mu);
   return status;
 }
@@ -368,11 +404,7 @@ void proxy_cast(uint8_t op, int32_t fd, const void* data, uint32_t len) {
   if (seq == 0) return;
   if (!send_event(seq, op, fd, data, len)) {
     pthread_mutex_lock(&resp_mu);
-    driver_dead = true;
-    proxy_fd = -1;
-    frontier = last_sent;
-    flush_outq_locked();
-    pthread_cond_broadcast(&resp_cv);
+    driver_death_locked();
     pthread_mutex_unlock(&resp_mu);
   }
 }
@@ -399,10 +431,12 @@ ssize_t hold_output(int fd, const void* buf, size_t count, int flags) {
   while (outq_bytes > kOutCap && !driver_dead)
     pthread_cond_wait(&resp_cv, &resp_mu);  // backpressure the app
   if (driver_dead) {
-    // the death handler already drained outq and nobody will ever
-    // flush again — queueing now would strand this reply forever
+    // a tracked fd only reaches here by racing the death handler,
+    // which severed it — this reply's input may never have committed,
+    // so it must NOT reach the client
     pthread_mutex_unlock(&resp_mu);
-    return real_write(fd, buf, count);
+    errno = ECONNRESET;
+    return -1;
   }
   if (!outq) outq = new std::deque<OutChunk>();
   OutChunk c;
@@ -498,6 +532,10 @@ int accept(int sockfd, struct sockaddr* addr, socklen_t* addrlen) {
   if (!real_accept) resolve();
   int fd = real_accept(sockfd, addr, addrlen);
   if (proxy_fd >= 0) on_accepted(fd);
+  // post-death quarantine: the speculative app has executed input that
+  // never committed — NEW sessions must not be served from its
+  // diverged state either (they get a reset and retry elsewhere)
+  else if (driver_dead && fd >= 0) shutdown(fd, SHUT_RDWR);
   return fd;
 }
 
@@ -506,6 +544,7 @@ int accept4(int sockfd, struct sockaddr* addr, socklen_t* addrlen,
   if (!real_accept4) resolve();
   int fd = real_accept4(sockfd, addr, addrlen, flags);
   if (proxy_fd >= 0) on_accepted(fd);
+  else if (driver_dead && fd >= 0) shutdown(fd, SHUT_RDWR);
   return fd;
 }
 

@@ -169,6 +169,20 @@ def main() -> None:
                     raise RuntimeError(
                         "force-pruned past apply cursor; requesting "
                         "world rebuild for snapshot recovery")
+                if node.app_dirty:
+                    # mis-speculation quarantine: the app executed
+                    # input that can no longer commit (deposed mid
+                    # flight) and must not serve again. Within a
+                    # generation nothing restarts the app process, so
+                    # convert the quarantine into a world rebuild —
+                    # the supervisor spawns a FRESH app and the next
+                    # generation bootstraps it from the committed
+                    # store. The store itself is clean (it only ever
+                    # holds committed entries), so our dump remains a
+                    # usable recovery point.
+                    raise RuntimeError(
+                        "speculative app diverged (app_dirty); "
+                        "requesting world rebuild for an app restart")
             # round barrier + a DURABLE full dump (fsynced triple —
             # the power-loss-safe recovery tier); a fully idle round
             # leaves the previous dump standing

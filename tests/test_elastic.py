@@ -389,3 +389,135 @@ def test_elastic_loss_restart_rejoin(tmp_path, built_native):
         for sup in sups.values():
             sup.stop()
         ctl.close()
+
+
+def test_leader_sigkill_under_speculative_load(tmp_path, built_native):
+    """The reference's RemoveLeader scenario (reconf_bench.sh:96-123) at
+    FULL stack depth with speculative clients in flight: SIGKILL the
+    LEADER's worker mid-drain while a pipelined spec-mode client is
+    streaming SETs. Asserts:
+
+    * output commit — every reply the client READ corresponds to an
+      entry that survives on the new world (acked => committed =>
+      durable across the leader's death);
+    * the dead host's diverged speculative app is discarded and a FRESH
+      app is rebuilt from the committed store (quarantine discipline at
+      generation granularity: new app pid, full history served);
+    * the rebuilt world replicates new writes everywhere.
+    """
+    from rdma_paxos_tpu.runtime.elastic import (ElasticSupervisor,
+                                                GroupController)
+    ctl = GroupController(expect=3, settle=1.2, barrier_timeout=90.0)
+    dirs = {h: str(tmp_path / f"h{h}") for h in range(3)}
+    cache = "/tmp/rp_elastic_jaxcache"
+    wenv = {"JAX_COMPILATION_CACHE_DIR": cache,
+            "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "1",
+            "RP_BENCH_CPU": "1"}
+
+    def mk_sup(h):
+        sup = ElasticSupervisor(
+            host_id=h, controller=f"127.0.0.1:{ctl.port}",
+            # long drain rounds: this test pushes a deep pipelined
+            # backlog, and the worker must not stall it on control
+            # beats (the default 12-iteration rounds are tuned for the
+            # churn-heavy rejoin test above)
+            workdir=dirs[h], app_port=APP_PORTS[h],
+            round_iters=100, cfg_json=CFG_JSON, worker_env=wenv)
+        t = threading.Thread(target=sup.run, daemon=True)
+        t.start()
+        return sup
+
+    sups = {h: mk_sup(h) for h in range(3)}
+    try:
+        spec1 = _wait_gen(ctl, 1)
+        assert [m["host"] for m in spec1["members"]] == [0, 1, 2]
+        lead = _wait_leader(dirs, [0, 1, 2], 1)
+        old_app_pid = sups[lead]._app.pid if sups[lead]._app else None
+
+        # pipelined speculative client: stream N SETs in one blob; the
+        # spec shim lets the app execute ahead while replies are held
+        # until commit
+        N = 40000
+        s = socket.create_connection(("127.0.0.1", APP_PORTS[lead]),
+                                     timeout=20)
+
+        # CONTINUOUS writer thread: keeps the submit backlog deep for
+        # the whole window so the kill provably lands with speculative
+        # input in flight (a single pre-sent blob can fully commit
+        # before the signal arrives — replies flush in large batches)
+        def writer():
+            try:
+                for i in range(N):
+                    s.sendall(b"SET kq%05d v%05d\n" % (i, i))
+            except OSError:
+                pass              # severed by the kill — expected
+        wt = threading.Thread(target=writer, daemon=True)
+        wt.start()
+        s.settimeout(10)
+        got = b""
+        while got.count(b"\n") < 2000:
+            chunk = s.recv(65536)
+            assert chunk, "connection died before the kill"
+            got += chunk
+
+        # ---- SIGKILL the leader's WORKER mid-burst ----
+        assert sups[lead]._child is not None
+        sups[lead]._child.kill()
+
+        # drain whatever replies still arrive until the shim severs
+        try:
+            while True:
+                chunk = s.recv(65536)
+                if not chunk:
+                    break
+                got += chunk
+        except OSError:
+            pass
+        s.close()
+        wt.join(timeout=30)
+        acked = got.count(b"\n")
+        assert 0 < acked < N, (
+            f"kill did not land mid-burst (acked={acked}/{N})")
+
+        # ---- the survivors cut a new generation without the leader ----
+        spec2 = _wait_gen(ctl, spec1["gen"] + 1)
+        survivors = [m["host"] for m in spec2["members"]]
+        # (the supervisor auto-re-registers the dead host, so it may
+        # already be back in spec2 — what matters is the group serves)
+        serving = [h for h in survivors]
+        _wait_leader(dirs, serving, spec2["gen"])
+
+        # ---- output commit: every ACKED reply's entry survives ----
+        # acks release in connection order, so the acked set is exactly
+        # the prefix kq0000..kq{acked-1}
+        check = next(h for h in serving if h != lead) \
+            if any(h != lead for h in serving) else serving[0]
+        assert _wait_kv(APP_PORTS[check], b"kq%05d" % (acked - 1),
+                        b"v%05d" % (acked - 1), timeout=240) == \
+            b"v%05d" % (acked - 1), "last acked write lost"
+        # spot-check the whole acked prefix in one connection
+        sc = socket.create_connection(("127.0.0.1", APP_PORTS[check]),
+                                      timeout=20)
+        fc = sc.makefile("rb")
+        for i in range(0, acked, max(1, acked // 50)):
+            sc.sendall(b"GET kq%05d\n" % i)
+            assert fc.readline().strip() == b"v%05d" % i, f"kq{i} lost"
+        sc.close()
+
+        # ---- the dead host rejoins with a FRESH app rebuilt from the
+        # committed store (the generation-level quarantine) ----
+        spec3 = _wait_member(ctl, lead, spec2["gen"] - 1)
+        assert _wait_kv(APP_PORTS[lead], b"kq%05d" % (acked - 1),
+                        b"v%05d" % (acked - 1), timeout=240) == \
+            b"v%05d" % (acked - 1), "rejoined host missing acked write"
+        new_app_pid = sups[lead]._app.pid if sups[lead]._app else None
+        assert new_app_pid is not None and new_app_pid != old_app_pid, \
+            "speculative app was not replaced after the kill"
+
+        # ---- and the rebuilt world replicates new writes ----
+        members3 = [m["host"] for m in spec3["members"]]
+        _replicated_set(dirs, members3, b"post", b"kill")
+    finally:
+        for sup in sups.values():
+            sup.stop()
+        ctl.close()

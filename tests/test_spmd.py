@@ -28,13 +28,18 @@ def test_spmd_replication_8_replicas():
         assert [p for (_, _, _, p) in c.replayed[r]] == [b"spmd!"]
 
 
-def test_psum_fanout_matches_gather():
+@pytest.mark.parametrize("mode", ["sim", "spmd"])
+def test_psum_fanout_matches_gather(mode):
     """The O(W) psum window broadcast must be observably identical to the
     O(R·W) gather-select fan-out under full connectivity (the only regime
-    it is specified for): same commits, same replayed bytes, same log."""
+    it is specified for): same commits, same replayed bytes, same log.
+    Parametrized over both execution modes because the collective
+    LOWERING differs only under ``shard_map`` (a real masked all-reduce
+    vs an all-gather + select); the vmap simulation lowers both to data
+    movement on one device."""
     runs = {}
     for fo in ("gather", "psum"):
-        c = SimCluster(CFG, 5, fanout=fo)
+        c = SimCluster(CFG, 5, mode=mode, fanout=fo)
         c.run_until_elected(0)
         for i in range(6):
             c.submit(0, b"op-%d" % i)
