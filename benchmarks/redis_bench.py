@@ -74,10 +74,10 @@ def main():
     ap.add_argument("--profile", action="store_true",
                     help="wall-time phase accounting of the driver poll "
                          "loop (device step / replay / apply / sync sums)")
-    ap.add_argument("--n-slots", type=int, default=2048)
-    ap.add_argument("--slot-bytes", type=int, default=512)
-    ap.add_argument("--window-slots", type=int, default=64)
-    ap.add_argument("--batch-slots", type=int, default=64)
+    ap.add_argument("--n-slots", type=int, default=8192)
+    ap.add_argument("--slot-bytes", type=int, default=256)
+    ap.add_argument("--window-slots", type=int, default=1024)
+    ap.add_argument("--batch-slots", type=int, default=1024)
     ap.add_argument("--fanout", default="psum",
                     choices=("psum", "gather"),
                     help="window fan-out: psum is the production "
