@@ -60,12 +60,30 @@ def test_full_stack_multiprocess(tmp_path):
             assert wait_kv(ports[r], b"dist", b"yes") == b"yes", \
                 f"replica {r} missing the replicated write"
     finally:
-        for p in procs:
-            p.kill()
-            p.wait()
+        _teardown(procs)
 
 
 _BOOT_SEQ = [0]
+
+
+def _teardown(procs):
+    """Kill the daemons and surface their output tails — a failed
+    multiprocess boot is otherwise undebuggable (stdout is piped).
+    The pipe is read NON-BLOCKING after the kill: the orphaned
+    toyserver grandchild inherits the write end, so a blocking read
+    (or communicate()) would never see EOF."""
+    for i, p in enumerate(procs):
+        p.kill()
+        p.wait()
+        tail = b""
+        if p.stdout is not None:
+            os.set_blocking(p.stdout.fileno(), False)
+            try:
+                tail = p.stdout.read() or b""
+            except OSError:
+                pass
+        print(f"--- node {i} output tail ---\n"
+              f"{tail.decode(errors='replace')[-1500:]}")
 
 
 def _boot_nodes(wd, iterations=20000, extra_env=None):
@@ -103,10 +121,9 @@ def _boot_nodes(wd, iterations=20000, extra_env=None):
         assert leader >= 0, "no leader line found"
     except BaseException:
         # never leak three daemons (and their orphaned toyservers)
-        # into the rest of the session on a failed boot
-        for p in procs:
-            p.kill()
-            p.wait()
+        # into the rest of the session on a failed boot — and dump
+        # their output tails, the only boot-failure evidence there is
+        _teardown(procs)
         raise
     return procs, leader, ports
 
@@ -151,9 +168,7 @@ def test_deep_queue_drains_through_bursts(tmp_path):
         # TPU hosts; this CPU harness validates correctness)
         assert dt < 60, "burst-mode drain too slow"
     finally:
-        for p in procs:
-            p.kill()
-            p.wait()
+        _teardown(procs)
 
 
 def test_multi_client_exactly_once_under_pipeline(tmp_path):
@@ -164,19 +179,24 @@ def test_multi_client_exactly_once_under_pipeline(tmp_path):
     wd = str(tmp_path)
     procs, leader, ports = _boot_nodes(wd)
     try:
+        errors = []
+
         def client(cid, n=300):
-            s = socket.create_connection(("127.0.0.1", ports[leader]),
-                                         timeout=20)
-            f = s.makefile("rb")
-            s.sendall(b"".join(b"SET c%d_%03d x\n" % (cid, i)
-                               for i in range(n)))
-            got = 0
-            while got < 4 * n:
-                chunk = f.read1(65536)
-                if not chunk:
-                    raise OSError("severed")
-                got += len(chunk)
-            s.close()
+            try:
+                s = socket.create_connection(
+                    ("127.0.0.1", ports[leader]), timeout=20)
+                f = s.makefile("rb")
+                s.sendall(b"".join(b"SET c%d_%03d x\n" % (cid, i)
+                                   for i in range(n)))
+                got = 0
+                while got < 4 * n:
+                    chunk = f.read1(65536)
+                    if not chunk:
+                        raise OSError("severed")
+                    got += len(chunk)
+                s.close()
+            except Exception as exc:  # noqa: BLE001 — surfaced below
+                errors.append((cid, repr(exc)))
         threads = [threading.Thread(target=client, args=(c,))
                    for c in range(4)]
         for t in threads:
@@ -184,6 +204,9 @@ def test_multi_client_exactly_once_under_pipeline(tmp_path):
         for t in threads:
             t.join(timeout=120)
             assert not t.is_alive()
+        # a swallowed client failure must fail HERE with its cause, not
+        # later at the replication check with no context
+        assert not errors, f"clients failed: {errors}"
         for r in range(3):
             if r == leader:
                 continue
@@ -191,6 +214,4 @@ def test_multi_client_exactly_once_under_pipeline(tmp_path):
                 assert wait_kv(ports[r], b"c%d_299" % c, b"x") == b"x", \
                     f"replica {r} client {c}"
     finally:
-        for p in procs:
-            p.kill()
-            p.wait()
+        _teardown(procs)
