@@ -186,8 +186,20 @@ class StableStore:
         if not self._h:
             raise OSError(f"cannot open stable store at {path}")
 
+    def _handle(self):
+        """Live native handle; use-after-close raises instead of handing
+        ctypes a NULL to segfault on (e.g. a second driver.stop()).
+        NOT a concurrency guard: a thread that read the handle before a
+        concurrent close() still races — callers must sequence close()
+        after their worker threads exit (ClusterDriver.stop refuses to
+        close under a live poll thread for exactly this reason)."""
+        h = self._h
+        if not h:
+            raise ValueError("stable store is closed")
+        return h
+
     def append(self, record: bytes) -> int:
-        idx = self._lib.ss_append(self._h, record, len(record))
+        idx = self._lib.ss_append(self._handle(), record, len(record))
         if idx < 0:
             raise OSError("stable store append failed")
         return idx
@@ -197,49 +209,49 @@ class StableStore:
         copy hot path fed by SimCluster's vectorized window decode."""
         if not blob:
             return 0
-        n = self._lib.ss_append_many(self._h, blob, len(blob))
+        n = self._lib.ss_append_many(self._handle(), blob, len(blob))
         if n < 0:
             raise OSError("stable store framed append failed")
         return int(n)
 
 
     def sync(self) -> None:
-        if self._lib.ss_sync(self._h) != 0:
+        if self._lib.ss_sync(self._handle()) != 0:
             raise OSError("fdatasync failed")
 
     def __len__(self) -> int:
         """ABSOLUTE record count (base + retained) — indices are stable
         across compaction."""
-        return int(self._lib.ss_count(self._h))
+        return int(self._lib.ss_count(self._handle()))
 
     @property
     def base(self) -> int:
         """Absolute index of the first retained record (0 unless
         compacted): records below it were dropped after an app-state
         checkpoint covered their effects."""
-        return int(self._lib.ss_base(self._h))
+        return int(self._lib.ss_base(self._handle()))
 
     def compact(self, upto: int) -> int:
         """Drop records below absolute index ``upto`` (crash-safe
         rewrite+rename). The caller must hold an app-state checkpoint
         taken at exactly ``upto`` — a fresh app is rebuilt as
         checkpoint + replay of [upto, len))."""
-        b = self._lib.ss_compact(self._h, upto)
+        b = self._lib.ss_compact(self._handle(), upto)
         if b < 0:
             raise OSError("stable store compaction failed")
         return int(b)
 
     def read(self, idx: int, cap: int = 1 << 20) -> bytes:
         buf = ctypes.create_string_buffer(cap)
-        n = self._lib.ss_read(self._h, idx, buf, cap)
+        n = self._lib.ss_read(self._handle(), idx, buf, cap)
         if n < 0:
             raise IndexError(idx)
         return buf.raw[:min(n, cap)]
 
     def dump(self) -> bytes:
-        n = self._lib.ss_dump_len(self._h)
+        n = self._lib.ss_dump_len(self._handle())
         buf = ctypes.create_string_buffer(max(int(n), 1))
-        w = self._lib.ss_dump(self._h, buf, n)
+        w = self._lib.ss_dump(self._handle(), buf, n)
         if w < 0:
             raise OSError("dump failed")
         return buf.raw[:w]
@@ -247,11 +259,11 @@ class StableStore:
     def reset(self) -> None:
         """Discard all records (pre-snapshot-load; ss_load appends, so a
         reload without reset would duplicate history)."""
-        if self._lib.ss_reset(self._h) != 0:
+        if self._lib.ss_reset(self._handle()) != 0:
             raise OSError("reset failed")
 
     def load(self, blob: bytes) -> int:
-        n = self._lib.ss_load(self._h, blob, len(blob))
+        n = self._lib.ss_load(self._handle(), blob, len(blob))
         if n < 0:
             raise OSError("malformed dump")
         return int(n)

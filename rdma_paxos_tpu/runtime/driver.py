@@ -905,10 +905,24 @@ class ClusterDriver:
         self.cluster.prewarm()
 
     def stop(self) -> None:
+        # idempotent: tests (and death-path drills) may stop explicitly
+        # and again from fixture teardown — the second call must not
+        # touch already-closed native handles
+        if getattr(self, "_stopped", False):
+            return
         self._stop.set()
         self._wake.set()
         if self._thread is not None:
             self._thread.join(timeout=5)
+            if self._thread.is_alive():
+                # a wedged poll thread (e.g. blocked inside a device
+                # step) may still be touching the native handles:
+                # closing them under it would be a use-after-free.
+                # Leak them loudly instead; a later stop() retries.
+                self.runtimes[0].log.info_wtime(
+                    "STOP: poll thread did not exit within 5s; "
+                    "leaving native handles open")
+                return
         # release commit waiters that were already inflight at stop —
         # nothing will ever step again, so they must fail, not hang
         with self._lock:
@@ -916,14 +930,19 @@ class ClusterDriver:
                 while rt.inflight:
                     ev, _ = rt.inflight.popleft()
                     ev.release(-1)
-        for rt in self.runtimes:
-            if rt.proxy:
-                rt.proxy.close()
-            if rt.replay:
-                rt.replay.close()
-            if rt.store:
-                rt.store.close()
-            rt.log.close()
+        try:
+            for rt in self.runtimes:
+                # one replica's close failure must not leak the rest
+                for res in (rt.proxy, rt.replay, rt.store, rt.log):
+                    if res is None:
+                        continue
+                    try:
+                        res.close()
+                    except OSError:
+                        pass
+        finally:
+            # latch only after the cleanup actually ran
+            self._stopped = True
 
     def leader(self) -> int:
         with self._lock:

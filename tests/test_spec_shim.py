@@ -241,3 +241,42 @@ def test_refused_send_at_intake_quarantines_spec_app(stack):
     assert driver.runtimes[lead].app_dirty, (
         "refused-at-intake speculated SEND did not quarantine the app")
     c.close()
+
+
+def test_driver_death_severs_without_fabricated_acks(stack):
+    """The shim's driver-death discipline: replies held for input the
+    dead driver never committed must NOT be released (that would
+    fabricate +OK acks for lost writes — the output-commit violation
+    round 5 found and fixed), and the diverged speculative app must
+    serve nothing — not even new sessions — until replaced."""
+    driver, _apps, _tmp = stack
+    lead = driver.leader()
+    c = Client(PORTS[lead])
+    assert c.cmd("SET alive yes") == b"+OK"
+
+    # an uncommittable write in flight (driver dies before stepping it)
+    c.send_only("SET phantom write")
+    driver.stop()
+
+    # the held reply must never arrive: sever, not ack
+    c.s.settimeout(5)
+    try:
+        data = c.s.recv(64)
+    except OSError:
+        data = b""
+    assert data == b"", (
+        "client received bytes after driver death: %r" % data)
+    c.close()
+
+    # the diverged app refuses NEW sessions too (a refused connect is
+    # the strongest form of that refusal)
+    try:
+        s = socket.create_connection(("127.0.0.1", PORTS[lead]),
+                                     timeout=5)
+        s.settimeout(5)
+        s.sendall(b"GET alive\n")
+        refused = s.recv(64) == b""
+        s.close()
+    except OSError:
+        refused = True
+    assert refused, "diverged app served a session after driver death"
