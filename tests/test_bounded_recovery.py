@@ -213,3 +213,55 @@ def test_checkpoint_compaction_keeps_rejoin_cost_flat(tmp_path):
         for a in apps:
             a.kill()
             a.wait()
+
+
+def test_checkpoint_quiesce_fallback_without_probe(tmp_path):
+    """A 2-tuple app_snapshot hook (no probe_fn) must still checkpoint
+    correctly through the kernel-queue quiescence fallback: the
+    compacted prefix has to cover exactly what the app consumed."""
+    apps, driver = [], None
+    ports = [7451, 7452, 7453]
+    try:
+        driver = ClusterDriver(
+            CFG, 3, workdir=str(tmp_path), app_ports=ports,
+            timeout_cfg=TimeoutConfig(elec_timeout_low=0.4,
+                                      elec_timeout_high=0.8),
+            app_snapshot=(toy_dump, toy_restore))   # NO probe
+        for r, port in enumerate(ports):
+            apps.append(spawn_app(tmp_path, r, port))
+        time.sleep(0.3)
+        driver.run(period=0.002)
+        deadline = time.time() + 60
+        while driver.leader() < 0 and time.time() < deadline:
+            time.sleep(0.05)
+        lead = driver.leader()
+        assert lead >= 0
+        fol = next(r for r in range(3) if r != lead)
+
+        c = Client(ports[lead])
+        for i in range(80):
+            assert c.cmd(f"SET q{i} v{i}") == b"+OK"
+        c.close()
+        assert wait_kv(ports[fol], "q79", b"v79") == b"v79"
+
+        driver.checkpoint_app(fol)
+        st = driver.runtimes[fol].store
+        assert st.base > 0, "compaction did not advance"
+
+        # the checkpoint must cover the compacted prefix: rebuild the
+        # app FRESH from checkpoint + suffix and verify full state
+        apps[fol].kill()
+        apps[fol].wait()
+        apps[fol] = spawn_app(tmp_path, fol, ports[fol])
+        time.sleep(0.3)
+        driver.reset_app(fol)
+        cv = Client(ports[fol])
+        assert cv.cmd("GET q0") == b"v0"      # from the checkpoint
+        assert cv.cmd("GET q79") == b"v79"
+        cv.close()
+    finally:
+        if driver is not None:
+            driver.stop()
+        for a in apps:
+            a.kill()
+            a.wait()
