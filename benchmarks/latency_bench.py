@@ -143,6 +143,12 @@ def build(cfg: LogConfig, batch: int, use_pallas=None):
 
 def measure(cfg: LogConfig, batch: int, iters: int = 400,
             use_pallas=None, pipeline_depth: int = 4):
+    # every timed sample also lands in an obs registry histogram so the
+    # row JSON carries full bucketed distributions (not just the three
+    # percentiles) for future BENCH_* rounds
+    from rdma_paxos_tpu.obs.metrics import (
+        LATENCY_BUCKETS_US as US_BUCKETS, MetricsRegistry)
+    reg = MetricsRegistry()
     elect, one, scan_k, consts = build(cfg, batch, use_pallas)
     state = stack_states(cfg, R, R)
     state = elect(state, *consts)
@@ -155,6 +161,8 @@ def measure(cfg: LogConfig, batch: int, iters: int = 400,
         state, c = one(state, *consts)
         c.block_until_ready()
         lat.append(time.perf_counter() - t0)
+        reg.observe("dispatch_latency_us", lat[-1] * 1e6,
+                    buckets=US_BUCKETS, batch=batch)
     disp = _pcts(lat)
 
     # pipelined mode: keep D dispatches in flight; each iteration blocks
@@ -172,6 +180,8 @@ def measure(cfg: LogConfig, batch: int, iters: int = 400,
         q.popleft().block_until_ready()
         t_now = time.perf_counter()
         intervals.append(t_now - t_prev)
+        reg.observe("pipelined_interval_us", (t_now - t_prev) * 1e6,
+                    buckets=US_BUCKETS, batch=batch)
         t_prev = t_now
     while q:
         q.popleft().block_until_ready()
@@ -217,7 +227,8 @@ def measure(cfg: LogConfig, batch: int, iters: int = 400,
                 pipelined=dict(depth=pipeline_depth, **pipe),
                 scan_step_us=float(per_step_us),
                 commit_throughput_scan=float(committed / scan_dt),
-                step_plus_readback_ms_p50=float(rb[len(rb) // 2] * 1e3))
+                step_plus_readback_ms_p50=float(rb[len(rb) // 2] * 1e3),
+                metrics=reg.snapshot())
 
 
 # the three measured profiles: latency geometry at batch 1 and 8, and

@@ -28,20 +28,37 @@ NATIVE = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "native")
 
 
-def client_worker(port, n, lat, tid, pipeline=1):
+def client_worker(port, n, lat, tid, pipeline=1, retries=5):
     """Pipelined client (the redis-benchmark -P analog): P commands per
     write — the app's read() picks them up as ONE buffer, so they ride a
-    single consensus event; latency is measured per pipelined batch."""
+    single consensus event; latency is measured per pipelined batch.
+    A severed connection (a refused event during leadership churn — the
+    shim fails fast with -1 and the session drops) reconnects and
+    retries the batch, bounded, exactly as a real client would."""
     s = socket.create_connection(("127.0.0.1", port), timeout=30)
     f = s.makefile("rb")
     done = 0
     while done < n:
         k = min(pipeline, n - done)
         t0 = time.perf_counter()
-        s.sendall(b"".join(b"SET k%d-%d v%d\n" % (tid, done + i, i)
-                           for i in range(k)))
-        for _ in range(k):
-            assert f.readline().strip() == b"+OK"
+        try:
+            s.sendall(b"".join(b"SET k%d-%d v%d\n" % (tid, done + i, i)
+                               for i in range(k)))
+            for _ in range(k):
+                if f.readline().strip() != b"+OK":
+                    raise OSError("severed mid-batch")
+        except OSError:
+            if retries <= 0:
+                raise
+            retries -= 1
+            try:
+                s.close()
+            except OSError:
+                pass
+            time.sleep(0.2)
+            s = socket.create_connection(("127.0.0.1", port), timeout=30)
+            f = s.makefile("rb")
+            continue                 # re-issue the same batch
         lat.append(time.perf_counter() - t0)
         done += k
     s.close()
@@ -63,6 +80,9 @@ def main():
                          "pipelined shim")
     ap.add_argument("--json", default=None,
                     help="append a JSON result line to this file")
+    ap.add_argument("--metrics-json", default=None,
+                    help="write the full obs metrics snapshot here "
+                         "(default: <workdir>/metrics.json)")
     args = ap.parse_args()
 
     os.environ.setdefault(
@@ -131,11 +151,33 @@ def main():
     print(f"committed SETs: {n} in {dt:.2f}s -> {n / dt:.0f} ops/s "
           f"({args.clients} clients, pipeline {args.pipeline}"
           f"{', threaded app' if args.threaded_app else ''})")
-    print(f"per-batch latency p50={lat[nb // 2] * 1e3:.2f}ms "
-          f"p95={lat[int(nb * .95)] * 1e3:.2f}ms "
-          f"p99={lat[int(nb * .99)] * 1e3:.2f}ms")
+    if nb:
+        print(f"per-batch latency p50={lat[nb // 2] * 1e3:.2f}ms "
+              f"p95={lat[int(nb * .95)] * 1e3:.2f}ms "
+              f"p99={lat[int(nb * .99)] * 1e3:.2f}ms")
+    else:
+        # the workload died (all clients exhausted their retries) —
+        # still fall through: the metrics/health export below is
+        # exactly the post-mortem such a run needs
+        print("per-batch latency: no completed batches")
+
+    # observability export: the registry snapshot (commit-latency
+    # histogram buckets, per-replica role/term gauges, rebase-headroom
+    # gauge, proxy/replay counters) rides alongside the wall-clock
+    # numbers so BENCH_* rounds carry protocol-level detail, and the
+    # aggregated health view prints for the operator
+    import json
+    metrics_snap = driver.obs.metrics.snapshot()
+    metrics_path = args.metrics_json or os.path.join(wd, "metrics.json")
+    driver.obs.metrics.write_json(metrics_path)
+    health = driver.health()
+    print(f"metrics snapshot: {metrics_path} "
+          f"({len(metrics_snap['counters'])} counters, "
+          f"{len(metrics_snap['gauges'])} gauges, "
+          f"{len(metrics_snap['histograms'])} histograms)")
+    print("METRICS:" + json.dumps(metrics_snap))
+    print("HEALTH:" + json.dumps(health))
     if args.json:
-        import json
         with open(args.json, "a") as jf:
             jf.write(json.dumps(dict(
                 metric="e2e_committed_ops_per_sec",
@@ -143,9 +185,13 @@ def main():
                 requests=n, seconds=round(dt, 3),
                 clients=args.clients, pipeline=args.pipeline,
                 threaded_app=bool(args.threaded_app),
-                p50_ms=round(lat[nb // 2] * 1e3, 2),
-                p95_ms=round(lat[int(nb * .95)] * 1e3, 2),
-                p99_ms=round(lat[int(nb * .99)] * 1e3, 2),
+                p50_ms=(round(lat[nb // 2] * 1e3, 2) if nb else None),
+                p95_ms=(round(lat[int(nb * .95)] * 1e3, 2)
+                        if nb else None),
+                p99_ms=(round(lat[int(nb * .99)] * 1e3, 2)
+                        if nb else None),
+                metrics=metrics_snap,
+                health=health,
             )) + "\n")
 
     # replication check on one follower

@@ -23,6 +23,22 @@ from typing import Optional
 # Log geometry
 # ---------------------------------------------------------------------------
 
+# Deepest fused burst any driver dispatches: NodeDaemon's single burst
+# tier is MAX_BURST_K steps; SimCluster's K_TIERS are capacity-clamped
+# per dispatch so a burst can never advance ``end`` past head +
+# n_slots - 1 anyway. Defined here (not in runtime/) because the
+# rebase-headroom validation below must account for it.
+MAX_BURST_K = 8
+
+# Consecutive post-threshold steps with the rebase delta pinned at 0
+# before the stall is surfaced (``rebase_stalled`` counter + trace
+# event — ADVICE.md #3). One definition for BOTH rollover drivers
+# (SimCluster and NodeDaemon) so their stall semantics cannot drift;
+# large enough to filter the benign one-or-two-step lag while a
+# healthy min head catches up to an n_slots multiple.
+REBASE_STALL_STEPS = 25
+
+
 @dataclasses.dataclass(frozen=True)
 class LogConfig:
     """Geometry of the on-device replicated log.
@@ -67,12 +83,23 @@ class LogConfig:
             raise ValueError("batch_slots must be <= window_slots")
         if self.rebase_threshold <= self.n_slots:
             raise ValueError("rebase_threshold must exceed n_slots")
-        # end may run ahead of the threshold by up to the ring capacity
-        # before the rollover lands; leave that headroom below I32_MAX
-        if self.rebase_threshold > (1 << 31) - 1 - 2 * self.n_slots:
+        # end may run ahead of the threshold before the rollover lands:
+        # after crossing, a fused burst can advance end by up to
+        # MAX_BURST_K batches in ONE dispatch (batch_slots <= n_slots
+        # per step), and a low min-head can round the agreed delta to 0
+        # for further steps — so the old 2*n_slots margin was
+        # insufficient under bursts (ADVICE.md #5). Require headroom
+        # proportional to the max burst depth; thresholds closer to the
+        # ceiling than this are rejected outright (tests that shrink
+        # the threshold to cross the boundary sit far below it).
+        headroom = (MAX_BURST_K + 2) * self.n_slots
+        if self.rebase_threshold > (1 << 31) - 1 - headroom:
             raise ValueError(
                 "rebase_threshold too close to the i32 ceiling; leave "
-                ">= 2*n_slots of headroom")
+                f">= (MAX_BURST_K+2)*n_slots = {headroom} of headroom "
+                "(fused bursts can advance end by up to "
+                "MAX_BURST_K*batch_slots past the threshold before the "
+                "rollover lands)")
 
     @property
     def slot_words(self) -> int:

@@ -27,6 +27,9 @@ import numpy as np
 from rdma_paxos_tpu.consensus.log import (
     Log, M_GIDX, M_TERM, META_W, slot_of)
 from rdma_paxos_tpu.consensus.state import ReplicaState
+from rdma_paxos_tpu.obs import trace as obs_trace
+from rdma_paxos_tpu.obs.metrics import default_registry
+from rdma_paxos_tpu.obs.trace import default_ring
 
 
 @dataclasses.dataclass
@@ -72,13 +75,20 @@ def take_snapshot(state_b: ReplicaState, donor: int,
         slot = (apply_ - 1) & (log.n_slots - 1)
         # single-element device read — never pulls the full log to host
         term = int(log.buf[donor, slot, log.slot_words + M_TERM])
-    return Snapshot(
+    snap = Snapshot(
         index=apply_, term=term, store_blob=store_blob,
         epoch=int(np.asarray(state_b.ccfg_epoch[donor])),
         bitmask_old=int(np.asarray(state_b.ccfg_old[donor])),
         bitmask_new=int(np.asarray(state_b.ccfg_new[donor])),
         cid_state=int(np.asarray(state_b.ccfg_cid[donor])),
     )
+    # host-side wrapper instrumentation (never inside the jitted body):
+    # snapshot traffic is the recovery-path signal operators watch
+    default_registry().inc("snapshots_taken_total")
+    default_ring().record(obs_trace.SNAPSHOT_TAKEN, replica=donor,
+                          index=snap.index, term=snap.term,
+                          store_bytes=len(store_blob))
+    return snap
 
 
 @jax.jit
@@ -263,7 +273,15 @@ def install_snapshot(state_b: ReplicaState, r: int, snap: Snapshot, *,
     cast (reference ``rc_get_replicated_vote``)."""
     i32 = lambda v: jnp.asarray(v, jnp.int32)
     eff_term = max(int(snap.term), int(cur_term), int(voted_term))
-    return _install(state_b, i32(r), i32(snap.index), i32(snap.term),
-                    i32(eff_term), i32(voted_term), i32(voted_for),
-                    i32(snap.epoch), i32(snap.bitmask_old),
-                    i32(snap.bitmask_new), i32(snap.cid_state))
+    out = _install(state_b, i32(r), i32(snap.index), i32(snap.term),
+                   i32(eff_term), i32(voted_term), i32(voted_for),
+                   i32(snap.epoch), i32(snap.bitmask_old),
+                   i32(snap.bitmask_new), i32(snap.cid_state))
+    # host-side wrapper instrumentation (the jitted _install stays
+    # pure) — recorded AFTER the install so a raising _install is never
+    # reported as an installed snapshot
+    default_registry().inc("snapshots_installed_total")
+    default_ring().record(obs_trace.SNAPSHOT_INSTALLED, replica=int(r),
+                          index=snap.index, term=snap.term,
+                          epoch=snap.epoch)
+    return out

@@ -1,0 +1,73 @@
+"""Cluster observability subsystem: metrics registry, protocol trace
+ring, and health snapshots.
+
+Three parts, all host-side, all zero-dependency (stdlib only):
+
+* :mod:`~rdma_paxos_tpu.obs.metrics` — thread-safe counters, gauges,
+  and fixed-bucket histograms with per-replica labels; ``snapshot()``
+  and JSON export for the bench harness.
+* :mod:`~rdma_paxos_tpu.obs.trace` — a bounded in-memory ring of typed
+  protocol events (elections, batches, commit advance, rebase
+  applied/stalled, snapshots, membership, proxy enqueue/ack-release),
+  dumpable on failure or on demand.
+* :mod:`~rdma_paxos_tpu.obs.health` — periodic per-replica health
+  snapshot files (role, term, indices, log headroom vs the i32 rebase
+  ceiling, inflight waiters, store progress), aggregated live by
+  ``ClusterDriver.health()``.
+
+HARD RULE: no metrics/trace call may execute inside a
+jitted/``shard_map``ped function — instrumentation lives in the host
+control plane only, so compiled-step programs (and their cache keys)
+are bit-identical with observability on or off. ``tests/test_obs.py``
+verifies exactly that.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from rdma_paxos_tpu.obs import health, metrics, trace
+from rdma_paxos_tpu.obs.health import HealthReporter
+from rdma_paxos_tpu.obs.metrics import MetricsRegistry
+from rdma_paxos_tpu.obs.trace import TraceRing
+
+
+class Observability:
+    """Facade bundling one registry + one trace ring — the unit the
+    drivers thread through every layer. Each :class:`ClusterDriver`
+    gets its own (isolated, test-friendly); module-level code with no
+    driver in scope records against :func:`default`."""
+
+    def __init__(self, metrics_registry: Optional[MetricsRegistry] = None,
+                 trace_ring: Optional[TraceRing] = None):
+        self.metrics = (metrics_registry if metrics_registry is not None
+                        else MetricsRegistry())
+        self.trace = (trace_ring if trace_ring is not None
+                      else TraceRing())
+
+    def snapshot(self) -> dict:
+        """Combined point-in-time export: the metrics snapshot plus the
+        trace ring's retained events."""
+        return {"metrics": self.metrics.snapshot(),
+                "trace": self.trace.dump()}
+
+    def reset(self) -> None:
+        self.metrics.reset()
+        self.trace.clear()
+
+
+_default: Optional[Observability] = None
+
+
+def default() -> Observability:
+    """The process-global facade over the module-level default registry
+    and ring (shared with all module-level instrumentation)."""
+    global _default
+    if _default is None:
+        _default = Observability(metrics.default_registry(),
+                                 trace.default_ring())
+    return _default
+
+
+__all__ = ["Observability", "MetricsRegistry", "TraceRing",
+           "HealthReporter", "default", "metrics", "trace", "health"]

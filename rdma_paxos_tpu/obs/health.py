@@ -1,0 +1,90 @@
+"""Health reporter — periodic per-replica health snapshots as JSON files.
+
+The drivers build one small dict per replica each reporting period
+(role, term, commit/apply indices, log headroom against the i32 rebase
+ceiling, inflight waiter count, stable-store progress) and this module
+writes each atomically (tmp + rename, never fsynced — loss only costs
+one period) to ``<workdir>/replica<r>.health.json``, where an operator,
+the bench harness, or a supervising process can poll them without
+touching the driver. ``ClusterDriver.health()`` aggregates the same
+dicts live.
+
+Schema: every snapshot carries at least :data:`HEALTH_FIELDS`; extra
+keys (store stats, rebase counters) ride along freely.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, List, Optional
+
+# the required schema — tests and aggregators key off these
+HEALTH_FIELDS = (
+    "replica", "role", "term", "leader_id",
+    "commit", "apply", "end", "head",
+    "log_headroom",          # rebase_threshold - end (i32 ceiling margin)
+    "inflight",              # blocked commit waiters
+    "ts",                    # time.time() at snapshot
+)
+
+
+def validate(snap: dict) -> List[str]:
+    """-> the list of required fields missing from ``snap`` (empty when
+    the snapshot conforms)."""
+    return [f for f in HEALTH_FIELDS if f not in snap]
+
+
+def make_snapshot(**fields) -> dict:
+    """Stamp ``fields`` into a schema-versioned snapshot dict."""
+    snap = dict(schema=1, ts=time.time())
+    snap.update(fields)
+    return snap
+
+
+class HealthReporter:
+    """Cadenced atomic per-replica JSON writer + reader."""
+
+    def __init__(self, workdir: str, period: float = 0.5,
+                 clock=time.monotonic):
+        self.workdir = workdir
+        self.period = period
+        self._clock = clock
+        self._last = float("-inf")
+
+    def path(self, replica: int) -> str:
+        return os.path.join(self.workdir, f"replica{replica}.health.json")
+
+    def due(self) -> bool:
+        return self._clock() - self._last >= self.period
+
+    def write(self, snaps: Dict[int, dict]) -> None:
+        """Write every replica's snapshot atomically and reset the
+        cadence clock. Atomic against process death (tmp + rename); NOT
+        fsynced — a power loss costs at most one period's snapshot,
+        which the next period rewrites."""
+        for r, snap in snaps.items():
+            path = self.path(r)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(snap, f, indent=2)
+            os.replace(tmp, path)
+        self._last = self._clock()
+
+    def maybe_write(self, snaps: Dict[int, dict]) -> bool:
+        """Cadenced write; returns True if a write happened."""
+        if not self.due():
+            return False
+        self.write(snaps)
+        return True
+
+    def read(self, replica: int) -> Optional[dict]:
+        try:
+            with open(self.path(replica)) as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return None
+
+    def read_all(self, n_replicas: int) -> List[Optional[dict]]:
+        return [self.read(r) for r in range(n_replicas)]

@@ -22,13 +22,23 @@ import functools
 import os
 import socket
 import struct
+import sys
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
 from rdma_paxos_tpu.consensus.log import EntryType
+from rdma_paxos_tpu.obs import trace as obs_trace
+from rdma_paxos_tpu.obs.metrics import default_registry
+from rdma_paxos_tpu.obs.trace import default_ring
 
 OP_HELLO, OP_CONNECT, OP_SEND, OP_CLOSE = 1, 2, 3, 4
+
+# one-shot stderr warning latch for unverifiable quiesce barriers (the
+# structured signal — quiesce_unknown trace event + counter — fires on
+# every occurrence; the human-readable line only once per process)
+_QUIESCE_UNKNOWN_WARNED = False
 
 
 def spec_send_refused_dirty(etype: int, conn_id: int, replicated_conns,
@@ -70,6 +80,10 @@ class PendingEvent:
     status: int = 0
     on_done: Optional[Callable[[int], None]] = None
     _cb_lock: threading.Lock = field(default_factory=threading.Lock)
+    # creation timestamp (perf_counter): release-site instrumentation
+    # measures intake→commit-release as the client-visible commit
+    # latency (obs commit_latency_seconds histogram)
+    t0: float = field(default_factory=time.perf_counter)
 
     def release(self, status: int = 0) -> None:
         self.status = status
@@ -112,8 +126,12 @@ class ProxyServer:
     def __init__(self, sock_path: str, node_id: int,
                  on_event: Callable[[int, int, bytes],
                                     Optional[PendingEvent]],
-                 conn_ctr_start: int = 0):
+                 conn_ctr_start: int = 0, obs=None):
         self.sock_path = sock_path
+        # Observability facade (rdma_paxos_tpu.obs) — link threads
+        # count wire events per op so replication throughput and shim
+        # pressure export with every snapshot
+        self.obs = obs
         # conn ids pack the origin into bits 24+ of an int32 log column
         # (M_CONN): an id >= 128 would flip the sign bit and break the
         # origin test ((conn >> 24) == host_id) everywhere downstream —
@@ -196,6 +214,9 @@ class ProxyServer:
                 payload = self._recv_exact(link, ln) if ln else b""
                 if payload is None:
                     return
+                if self.obs is not None:
+                    self.obs.metrics.inc("proxy_wire_events_total",
+                                         replica=self.node_id, op=op)
                 if op not in _OP_TO_ETYPE:       # HELLO / unknown
                     if op == OP_HELLO and payload:
                         self.spec_mode = bool(payload[0] & 1)
@@ -364,20 +385,58 @@ class ReplayEngine:
             finally:
                 s.settimeout(None)
 
+    # both address families: a dual-stack or v6-bound app's loopback
+    # sockets appear in tcp6 (with v4-mapped peers), invisible to the
+    # IPv4 table — scanning only /proc/net/tcp silently weakened the
+    # barrier there (ADVICE.md #2)
+    _PROC_TCP_PATHS = ("/proc/net/tcp", "/proc/net/tcp6")
+
+    def _quiesce_unknown(self, reason: str) -> None:
+        """The kernel-queue barrier could not be VERIFIED (unreadable
+        /proc tables, failed ioctl with no compensating peer check):
+        record it as unknown — never as quiescent. Logged once per
+        process (stderr); traced/counted on every occurrence."""
+        default_ring().record(obs_trace.QUIESCE_UNKNOWN, reason=reason)
+        default_registry().inc("quiesce_unknown_total")
+        global _QUIESCE_UNKNOWN_WARNED
+        if not _QUIESCE_UNKNOWN_WARNED:
+            _QUIESCE_UNKNOWN_WARNED = True
+            print("ReplayEngine.quiesce: cannot verify kernel queues "
+                  f"({reason}); treating as NOT quiescent — supply an "
+                  "app_snapshot probe_fn for an exact barrier",
+                  file=sys.stderr, flush=True)
+
     def quiesce(self, timeout: float = 5.0,
                 settle_rounds: int = 3) -> bool:
         """Best-effort app-agnostic barrier (used when no probe hook is
         configured): wait until every replay connection's bytes have
         left BOTH kernel queues — our unsent send queue (TIOCOUTQ) and
-        the app-side receive queue (via /proc/net/tcp rx_queue for the
-        loopback peer socket) — over ``settle_rounds`` consecutive
+        the app-side receive queue (via /proc/net/tcp{,6} rx_queue for
+        the loopback peer socket) — over ``settle_rounds`` consecutive
         samples. NARROWS but does NOT close the race: bytes the app has
         read() into userspace buffers (or lines applied one at a time
         between lock releases) are invisible to kernel queues, so a
         checkpoint can still observe partially-applied input. Apps that
         can express a request/response no-op should supply the
-        app_snapshot probe_fn, which is exact. Returns True if
-        quiescent, False on timeout."""
+        app_snapshot probe_fn, which is exact.
+
+        Unverifiable is UNKNOWN, never 'empty' (the old behavior
+        silently counted both a failed TIOCOUTQ ioctl and an unreadable
+        /proc/net/tcp as empty, degrading the barrier to nothing on
+        IPv6 loopback / non-Linux / sandboxed kernels — ADVICE.md #2):
+
+        * no readable /proc/net/tcp{,6} table → return False (the
+          app-side rx queue is unknowable);
+        * TIOCOUTQ unsupported (e.g. sandboxed kernels) → degrade to
+          requiring the peer-rx check to VERIFY every replay port (a
+          matching row with rx_queue 0 in a readable table); if any
+          port cannot be matched, return False.
+
+        Both degradations log once per process and emit a
+        ``quiesce_unknown`` trace event + counter so the weakened
+        barrier is visible, and a returned False makes the caller
+        abort the checkpoint instead of compacting records the
+        checkpoint may not cover."""
         import fcntl
         import struct
         import termios
@@ -387,13 +446,19 @@ class ReplayEngine:
         quiet = 0
         while True:
             busy = False
+            sendq_verified = True
             ports = {}
+            n_conns = 0
             for s in list(self.conns.values()):
+                n_conns += 1
                 try:
                     out = struct.unpack(
                         "i", fcntl.ioctl(s.fileno(), termios.TIOCOUTQ,
                                          b"\x00" * 4))[0]
                 except OSError:
+                    # unknown, NOT empty: fall through to the peer-rx
+                    # check, which must then verify this socket
+                    sendq_verified = False
                     out = 0
                 if out:
                     busy = True
@@ -402,23 +467,50 @@ class ReplayEngine:
                     ports[s.getsockname()[1]] = True
                 except OSError:
                     pass
-            if not busy and ports:
+            if not busy and n_conns:
                 # peer (app-side) sockets: local == app port, remote ==
                 # one of our replay ports; rx_queue is hex field 4 after
-                # the colon in /proc/net/tcp
-                try:
-                    with open("/proc/net/tcp") as f:
-                        for ln in f.readlines()[1:]:
+                # the colon — same field layout in tcp and tcp6 (the
+                # address is longer, the :port suffix parse is
+                # identical)
+                readable = 0
+                matched = set()
+                for proc in self._PROC_TCP_PATHS:
+                    try:
+                        with open(proc) as f:
+                            lines = f.readlines()[1:]
+                    except OSError:
+                        continue     # this table unreadable
+                    readable += 1
+                    for ln in lines:
+                        try:
                             parts = ln.split()
                             lport = int(parts[1].split(":")[1], 16)
                             rport = int(parts[2].split(":")[1], 16)
                             if lport == app_port and rport in ports:
                                 rxq = int(parts[4].split(":")[1], 16)
+                                matched.add(rport)
                                 if rxq:
                                     busy = True
                                     break
-                except (OSError, IndexError, ValueError):
-                    pass  # /proc unavailable: fall through on send-q only
+                        except (IndexError, ValueError):
+                            continue  # garbled row: not a verification
+                    if busy:
+                        break
+                if readable == 0:
+                    self._quiesce_unknown(
+                        "no readable /proc/net/tcp{,6}")
+                    return False
+                if (not busy and not sendq_verified
+                        and (len(matched) < n_conns
+                             or len(ports) < n_conns)):
+                    # the send queue was unverifiable AND at least one
+                    # replay socket has no visible peer row: nothing
+                    # proves its bytes were consumed
+                    self._quiesce_unknown(
+                        "TIOCOUTQ unsupported and peer rows missing "
+                        f"({len(matched)}/{n_conns} verified)")
+                    return False
             if not busy:
                 quiet += 1
                 if quiet >= settle_rounds:

@@ -182,6 +182,14 @@ class StableStore:
 
     def __init__(self, path: str):
         self._lib = _load()
+        self.path = path
+        # host-side progress accounting for health snapshots / metrics
+        # (this wrapper is the single append doorway, so counting here
+        # covers every record): records/bytes appended through THIS
+        # handle since open — the durable truth stays in the file
+        self.appended_records = 0
+        self.appended_bytes = 0
+        self.syncs = 0
         self._h = self._lib.ss_open(path.encode())
         if not self._h:
             raise OSError(f"cannot open stable store at {path}")
@@ -202,6 +210,8 @@ class StableStore:
         idx = self._lib.ss_append(self._handle(), record, len(record))
         if idx < 0:
             raise OSError("stable store append failed")
+        self.appended_records += 1
+        self.appended_bytes += len(record)
         return idx
 
     def append_framed(self, blob: bytes) -> int:
@@ -212,12 +222,34 @@ class StableStore:
         n = self._lib.ss_append_many(self._handle(), blob, len(blob))
         if n < 0:
             raise OSError("stable store framed append failed")
+        self.appended_records += int(n)
+        self.appended_bytes += len(blob)
         return int(n)
-
 
     def sync(self) -> None:
         if self._lib.ss_sync(self._handle()) != 0:
             raise OSError("fdatasync failed")
+        self.syncs += 1
+
+    def stats(self) -> dict:
+        """Health-snapshot summary: absolute record count, compaction
+        base, bytes/records appended through this handle, fdatasync
+        count, and the backing file size. Safe on a CLOSED store (the
+        post-stop ``driver.health()`` call is exactly the post-mortem
+        this feeds): native-handle reads degrade to -1 instead of
+        raising."""
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            size = -1
+        try:
+            records, base = len(self), self.base
+        except ValueError:           # handle closed
+            records, base = -1, -1
+        return dict(records=records, base=base,
+                    appended_records=self.appended_records,
+                    appended_bytes=self.appended_bytes,
+                    syncs=self.syncs, file_bytes=size)
 
     def __len__(self) -> int:
         """ABSOLUTE record count (base + retained) — indices are stable

@@ -33,13 +33,16 @@ from rdma_paxos_tpu.consensus.membership import MembershipManager
 from rdma_paxos_tpu.consensus.snapshot import (
     install_snapshot, recover_vote, take_snapshot)
 from rdma_paxos_tpu.consensus.state import ConfigState, Role
+from rdma_paxos_tpu.obs import Observability, trace as obs_trace
+from rdma_paxos_tpu.obs.health import HealthReporter, make_snapshot
+from rdma_paxos_tpu.obs.metrics import BATCH_BUCKETS, LATENCY_BUCKETS_S
 from rdma_paxos_tpu.proxy.proxy import (
     PendingEvent, ProxyServer, ReplayEngine, spec_send_refused_dirty)
 from rdma_paxos_tpu.proxy.stablestore import (
     HardState, StableStore, atomic_write)
 from rdma_paxos_tpu.runtime.sim import SimCluster
 from rdma_paxos_tpu.runtime.timers import ElectionTimer
-from rdma_paxos_tpu.utils.debug import ReplicaLog
+from rdma_paxos_tpu.utils.debug import ReplicaLog, StepTimer
 from rdma_paxos_tpu.utils.codec import fragment
 
 
@@ -53,10 +56,10 @@ class _ReplicaRuntime:
     def __init__(self, idx: int, sock_path: Optional[str],
                  app_port: Optional[int], store_path: Optional[str],
                  on_event, timeout_cfg: TimeoutConfig, seed: int,
-                 log_path: Optional[str] = None):
+                 log_path: Optional[str] = None, obs=None):
         self.idx = idx
-        self.log = ReplicaLog(log_path)
-        self.proxy = (ProxyServer(sock_path, idx, on_event)
+        self.log = ReplicaLog(log_path, replica=idx, obs=obs)
+        self.proxy = (ProxyServer(sock_path, idx, on_event, obs=obs)
                       if sock_path else None)
         self.app_port = app_port
         self.replay = (ReplayEngine("127.0.0.1", app_port)
@@ -100,10 +103,21 @@ class ClusterDriver:
                  mode: str = "sim", seed: int = 0,
                  auto_evict: bool = False, fail_threshold: int = 100,
                  sync_period: float = 0.05, step_down_steps: int = 50,
-                 app_snapshot=None, fanout: str = "gather"):
+                 app_snapshot=None, fanout: str = "gather",
+                 obs: Optional[Observability] = None,
+                 health_period: float = 0.5):
         self.cfg = cfg
         self.sync_period = sync_period
         self._workdir = workdir
+        # observability: one registry + trace ring per driver (isolated
+        # by default — pass a shared facade to aggregate across
+        # drivers). ALL instrumentation is host-side: nothing below may
+        # run inside jitted code, and tests verify compiled-step cache
+        # keys are unchanged by it.
+        self.obs = obs if obs is not None else Observability()
+        self._timer_obs = StepTimer(metrics=self.obs.metrics)
+        self._health = (HealthReporter(workdir, period=health_period)
+                        if workdir else None)
         # bounded recovery: optional app-level snapshot hook tuple
         # (dump_fn(sock)->bytes, restore_fn(sock, blob)[, probe_fn(sock)])
         # speaking the app's own protocol over a passthrough connection.
@@ -138,6 +152,10 @@ class ClusterDriver:
         # tests can model partitions (see replica_step's docstring)
         self.cluster = SimCluster(cfg, n_replicas, group_size, mode=mode,
                                   fanout=fanout)
+        self.cluster.obs = self.obs
+        # absolute (rebase-corrected) commit cursor per replica, for the
+        # committed_entries_total counters / commit_advance traces
+        self._prev_commit_abs = np.zeros(n_replicas, np.int64)
         self.timeout_cfg = timeout_cfg or TimeoutConfig()
         # failure detection / eviction (check_failure_count analog):
         # consecutive steps each member failed to ack the leader's window
@@ -177,7 +195,7 @@ class ClusterDriver:
             self.runtimes.append(_ReplicaRuntime(
                 r, sock, port, store,
                 self._make_handler(r), self.timeout_cfg, seed + r,
-                log_path=logp))
+                log_path=logp, obs=self.obs))
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.loop_error: Optional[BaseException] = None
@@ -210,6 +228,8 @@ class ClusterDriver:
                         rt.log.info_wtime(
                             "APP DIRTY: speculated SEND refused at "
                             "intake (conn %d)" % conn_id)
+                    self.obs.metrics.inc("events_refused_total",
+                                         replica=r)
                     return -1
 
                 if self.loop_error is not None or self._stop.is_set():
@@ -278,6 +298,11 @@ class ClusterDriver:
                     self._submitq[r].append((etype, conn_id, f,
                                              rt.submit_seq))
                 rt.inflight.append((ev, rt.submit_seq))
+                self.obs.metrics.inc("proxy_events_total", replica=r)
+                self.obs.trace.record(obs_trace.PROXY_ENQUEUE,
+                                      replica=r, etype=etype,
+                                      conn=conn_id, frags=len(frags),
+                                      submit_seq=rt.submit_seq)
                 self._wake.set()
                 return ev
         return on_event
@@ -349,7 +374,9 @@ class ClusterDriver:
                 and self._leader_view >= 0 and self.cluster.last is not None
                 and max(len(q) for q in self.cluster.pending)
                 > self.cfg.batch_slots):
+            self._timer_obs.start("device_step")
             res = self.cluster.step_burst()
+            self._timer_obs.stop("device_step")
         else:
             timeouts = []
             last = self.cluster.last
@@ -359,6 +386,13 @@ class ClusterDriver:
                 if rt.timer.expired() or r == depose:
                     timeouts.append(r)
                     rt.timer.beat()
+                    self.obs.metrics.inc("election_timeouts_total",
+                                         replica=r)
+                    self.obs.trace.record(
+                        obs_trace.ELECTION_START, replica=r,
+                        depose=(r == depose),
+                        term=(int(last["term"][r])
+                              if last is not None else 0))
                     if r != depose:
                         # a deliberate deposition is not a mistimed
                         # timeout: it must not feed the adaptive
@@ -367,7 +401,9 @@ class ClusterDriver:
                         rt.fired_leader = (int(last["leader_id"][r])
                                            if last is not None else -1)
                         rt.fired_countdown = 50
+            self._timer_obs.start("device_step")
             res = self.cluster.step(timeouts=timeouts)
+            self._timer_obs.stop("device_step")
 
         with self._lock:
             # multiple self-claimed leaders can coexist transiently (an
@@ -437,7 +473,103 @@ class ClusterDriver:
                     rt.app_dirty = True
                     rt.log.info_wtime("AUTO-RECOVERY FAILED: %s" % exc)
                 self.cluster.need_recovery.discard(r)
+        self._observe_step(res)
         return res
+
+    # ------------------------------------------------------------------
+    # observability (host-side only — see rdma_paxos_tpu.obs)
+    # ------------------------------------------------------------------
+
+    def _observe_step(self, res) -> None:
+        """Export the step's protocol-level signals: per-replica
+        role/term/index gauges, rebase headroom against the i32
+        ceiling, commit-advance counters + trace, batch-size histogram,
+        and the cadenced health snapshot files."""
+        m = self.obs.metrics
+        rebased = getattr(self.cluster, "rebased_total", 0)
+        for r in range(self.R):
+            m.set("replica_role", int(res["role"][r]), replica=r)
+            m.set("replica_term", int(res["term"][r]), replica=r)
+            m.set("commit_index", int(res["commit"][r]), replica=r)
+            m.set("apply_index", int(res["apply"][r]), replica=r)
+            m.set("end_index", int(res["end"][r]), replica=r)
+            m.set("rebase_headroom",
+                  self.cfg.rebase_threshold - int(res["end"][r]),
+                  replica=r)
+            m.set("inflight_waiters", len(self.runtimes[r].inflight),
+                  replica=r)
+            acc = int(res["accepted"][r])
+            if acc > 0:
+                m.inc("accepted_entries_total", acc, replica=r)
+                m.observe("step_batch_entries", acc,
+                          buckets=BATCH_BUCKETS, replica=r)
+                self.obs.trace.record(obs_trace.STEP_BATCH, replica=r,
+                                      entries=acc)
+            commit_abs = int(res["commit"][r]) + rebased
+            delta = commit_abs - int(self._prev_commit_abs[r])
+            if delta > 0:
+                self._prev_commit_abs[r] = commit_abs
+                m.inc("committed_entries_total", delta, replica=r)
+                self.obs.trace.record(obs_trace.COMMIT_ADVANCE,
+                                      replica=r, commit=commit_abs,
+                                      delta=delta)
+        if self._health is not None and self._health.due():
+            try:
+                self._health.write(self._health_snapshots(res))
+            except OSError:
+                # observability I/O must never kill the data path: a
+                # vanished workdir / full disk costs the snapshot, not
+                # the poll loop (an OSError here would otherwise be
+                # treated as a fatal step crash and fail every inflight
+                # commit)
+                pass
+
+    def _health_snapshots(self, res) -> Dict[int, Dict]:
+        """Per-replica health dicts (the obs.health schema plus store /
+        rebase extras) — written to ``replica<r>.health.json`` on the
+        reporter cadence and aggregated live by :meth:`health`."""
+        snaps = {}
+        for r in range(self.R):
+            rt = self.runtimes[r]
+            snaps[r] = make_snapshot(
+                replica=r,
+                role=int(res["role"][r]),
+                term=int(res["term"][r]),
+                leader_id=int(res["leader_id"][r]),
+                commit=int(res["commit"][r]),
+                apply=int(res["apply"][r]),
+                end=int(res["end"][r]),
+                head=int(res["head"][r]),
+                log_headroom=(self.cfg.rebase_threshold
+                              - int(res["end"][r])),
+                inflight=len(rt.inflight),
+                app_dirty=rt.app_dirty,
+                stepped_down=r in self.stepped_down,
+                need_recovery=r in self.cluster.need_recovery,
+                rebases=self.cluster.rebases,
+                rebase_stalled=self.cluster.rebase_stalled,
+                store=(rt.store.stats() if rt.store is not None
+                       else None),
+            )
+        return snaps
+
+    def health(self) -> Dict:
+        """Aggregated cluster health (live — not from the files): the
+        per-replica snapshots plus the cluster-level view. Safe to call
+        from any thread; uses the last completed step's outputs."""
+        res = self.cluster.last
+        replicas = (self._health_snapshots(res) if res is not None
+                    else {})
+        return dict(
+            leader=self.leader(),
+            n_replicas=self.R,
+            replicas=[replicas[r] for r in sorted(replicas)],
+            rebases=self.cluster.rebases,
+            rebase_stalled=self.cluster.rebase_stalled,
+            loop_error=(repr(self.loop_error)
+                        if self.loop_error else None),
+            ts=time.time(),
+        )
 
     # ------------------------------------------------------------------
     # failure detection + eviction (push-detection analog: WC failures
@@ -456,9 +588,15 @@ class ClusterDriver:
             rt.log.info_wtime(
                 "APP DIRTY: %d speculated events failed at %s"
                 % (len(rt.inflight), site))
+        n = len(rt.inflight)
         while rt.inflight:
             ev, _ = rt.inflight.popleft()
             ev.release(-1)
+        if n:
+            self.obs.metrics.inc("inflight_failed_total", n,
+                                 replica=rt.idx)
+            self.obs.trace.record(obs_trace.INFLIGHT_FAILED,
+                                  replica=rt.idx, count=n, site=site)
 
     def _step_down_detector(self, res) -> None:
         """Lost-majority step-down (dare_server.c:1213-1217 analog): a
@@ -480,6 +618,10 @@ class ClusterDriver:
                     and self.unverified[r] >= self.step_down_steps):
                 self.stepped_down.add(r)
                 rt = self.runtimes[r]
+                self.obs.metrics.inc("step_downs_total", replica=r)
+                self.obs.trace.record(obs_trace.STEP_DOWN, replica=r,
+                                      term=int(res["term"][r]),
+                                      unverified=int(self.unverified[r]))
                 rt.log.info_wtime(
                     "[T%d] LOST MAJORITY: stepping down after %d "
                     "unverified steps" % (int(res["term"][r]),
@@ -523,6 +665,11 @@ class ClusterDriver:
                                         cur["epoch"] + 1)
                 self._config_phase = ("transit", new_mask,
                                       cur["epoch"] + 1, 500)
+                self.obs.metrics.inc("evictions_total", len(dead))
+                self.obs.trace.record(obs_trace.MEMBERSHIP_CHANGE,
+                                      phase="evict_transit", dead=dead,
+                                      new_mask=new_mask,
+                                      epoch=cur["epoch"] + 1)
 
     def _drive_config_change(self) -> None:
         """Advance a two-phase (joint-consensus) config change one poll
@@ -536,6 +683,10 @@ class ClusterDriver:
             # abandon so the failure detector / operator can resubmit
             self._config_phase = None
             self.config_changes_abandoned += 1
+            self.obs.metrics.inc("config_changes_abandoned_total")
+            self.obs.trace.record(obs_trace.MEMBERSHIP_CHANGE,
+                                  phase="abandoned", new_mask=new_mask,
+                                  epoch=epoch)
             return
         self._config_phase = (phase, new_mask, epoch, ttl - 1)
         lead = self._leader_view
@@ -551,10 +702,18 @@ class ClusterDriver:
                     and committed):
                 self._mm.submit_stable(lead, new_mask, epoch + 1)
                 self._config_phase = ("stable", new_mask, epoch + 1, ttl)
+                self.obs.trace.record(obs_trace.MEMBERSHIP_CHANGE,
+                                      phase="stable_submitted",
+                                      new_mask=new_mask,
+                                      epoch=epoch + 1)
         elif phase == "stable":
             if (cur["epoch"] >= epoch
                     and cur["cid_state"] == int(ConfigState.STABLE)):
                 self._config_phase = None
+                self.obs.metrics.inc("config_changes_total")
+                self.obs.trace.record(obs_trace.MEMBERSHIP_CHANGE,
+                                      phase="complete",
+                                      new_mask=new_mask, epoch=epoch)
 
     def request_membership(self, new_mask: int) -> None:
         """Operator API: start a two-phase change to ``new_mask`` (join /
@@ -566,6 +725,9 @@ class ClusterDriver:
         self._mm.submit_transit(lead, cur["bitmask_new"], new_mask,
                                 cur["epoch"] + 1)
         self._config_phase = ("transit", new_mask, cur["epoch"] + 1, 500)
+        self.obs.trace.record(obs_trace.MEMBERSHIP_CHANGE,
+                              phase="transit_requested",
+                              new_mask=new_mask, epoch=cur["epoch"] + 1)
 
     def recover_replica(self, r: int, donor: Optional[int] = None,
                         timeout: float = 60.0) -> None:
@@ -681,6 +843,9 @@ class ClusterDriver:
         path = self._ckpt_path(r)
         atomic_write(path, struct.pack("<Q", n) + blob)
         rt.store.compact(n)
+        self.obs.metrics.inc("checkpoints_total", replica=r)
+        self.obs.trace.record(obs_trace.CHECKPOINT_TAKEN, replica=r,
+                              record=n, blob_bytes=len(blob))
         rt.log.info_wtime(
             "CHECKPOINT: app state at record %d (%d bytes); store "
             "compacted" % (n, len(blob)))
@@ -846,6 +1011,11 @@ class ClusterDriver:
             if now - rt.last_sync > self.sync_period:
                 rt.store.sync()
                 rt.last_sync = now
+        if replaying and new:
+            n_replayed = sum(1 for e in new if conn_origin(e[1]) != r)
+            if n_replayed:
+                self.obs.metrics.inc("replayed_entries_total",
+                                     n_replayed, replica=r)
         if own_max >= 0:
             # ack release by sequence: every own-origin entry carries
             # the fragment seq in req_id (monotone in commit order), so
@@ -855,8 +1025,18 @@ class ClusterDriver:
                 while rt.inflight and rt.inflight[0][1] <= own_max:
                     ev, _ = rt.inflight.popleft()
                     releases.append(ev)
+            now = time.perf_counter()
             for ev in releases:
                 ev.release(0)
+                # intake→release is the client-visible commit latency
+                # (the spin at proxy.c:160, measured instead of spun)
+                self.obs.metrics.observe(
+                    "commit_latency_seconds", now - ev.t0,
+                    buckets=LATENCY_BUCKETS_S, replica=r)
+            if releases:
+                self.obs.trace.record(obs_trace.PROXY_ACK_RELEASE,
+                                      replica=r, count=len(releases),
+                                      submit_seq=own_max)
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -883,11 +1063,21 @@ class ClusterDriver:
                     import traceback
                     self.loop_error = exc
                     traceback.print_exc()
+                    self.obs.metrics.inc("loop_errors_total")
                     with self._lock:
                         for rt in self.runtimes:
-                            while rt.inflight:
-                                ev, _ = rt.inflight.popleft()
-                                ev.release(-1)
+                            self._fail_inflight_locked(
+                                rt, "poll-loop crash")
+                    if self._workdir is not None:
+                        # post-mortem: persist the protocol trace ring
+                        # next to the replica logs
+                        try:
+                            self.obs.trace.dump_on_failure(
+                                os.path.join(self._workdir,
+                                             "trace_dump.json"),
+                                reason=f"poll-loop crash: {exc!r}")
+                        except OSError:
+                            pass
                     return
                 with self._lock:
                     busy = (any(self._submitq)
@@ -904,7 +1094,7 @@ class ClusterDriver:
         round never eats a multi-second JIT pause mid-serving."""
         self.cluster.prewarm()
 
-    def stop(self) -> None:
+    def stop(self, join_timeout: float = 5.0) -> None:
         # idempotent: tests (and death-path drills) may stop explicitly
         # and again from fixture teardown — the second call must not
         # touch already-closed native handles
@@ -913,23 +1103,44 @@ class ClusterDriver:
         self._stop.set()
         self._wake.set()
         if self._thread is not None:
-            self._thread.join(timeout=5)
+            self._thread.join(timeout=join_timeout)
             if self._thread.is_alive():
                 # a wedged poll thread (e.g. blocked inside a device
                 # step) may still be touching the native handles:
                 # closing them under it would be a use-after-free.
                 # Leak them loudly instead; a later stop() retries.
+                # But FIRST fail every blocked commit waiter: with
+                # _stop set no step will ever release them, so app
+                # threads parked in proxy_call commit waits would hang
+                # forever instead of failing fast with -1 (releasing a
+                # PendingEvent is pure host state — safe regardless of
+                # what the wedged thread is doing; a concurrent release
+                # from it is an idempotent no-op) — ADVICE.md #4.
+                with self._lock:
+                    n = sum(len(rt.inflight) for rt in self.runtimes)
+                    for rt in self.runtimes:
+                        self._fail_inflight_locked(
+                            rt, "stop (wedged poll thread)")
+                self.obs.trace.record(obs_trace.STOP_FORCED,
+                                      released=n)
+                if self._workdir is not None:
+                    try:
+                        self.obs.trace.dump_on_failure(
+                            os.path.join(self._workdir,
+                                         "trace_dump.json"),
+                            reason="stop: wedged poll thread")
+                    except OSError:
+                        pass
                 self.runtimes[0].log.info_wtime(
-                    "STOP: poll thread did not exit within 5s; "
-                    "leaving native handles open")
+                    "STOP: poll thread did not exit within %gs; "
+                    "released %d inflight waiters with -1; leaving "
+                    "native handles open" % (join_timeout, n))
                 return
         # release commit waiters that were already inflight at stop —
         # nothing will ever step again, so they must fail, not hang
         with self._lock:
             for rt in self.runtimes:
-                while rt.inflight:
-                    ev, _ = rt.inflight.popleft()
-                    ev.release(-1)
+                self._fail_inflight_locked(rt, "stop")
         try:
             for rt in self.runtimes:
                 # one replica's close failure must not leak the rest

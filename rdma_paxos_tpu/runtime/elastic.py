@@ -71,6 +71,10 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from rdma_paxos_tpu.obs import trace as obs_trace
+from rdma_paxos_tpu.obs.metrics import default_registry
+from rdma_paxos_tpu.obs.trace import default_ring
+
 
 # ---------------------------------------------------------------------------
 # framing helpers
@@ -313,6 +317,11 @@ class GroupController:
         if self._spec is not None:
             print(f"controller: gen {self._gen} break — {reason}",
                   flush=True)
+            # structured twin of the print: the elastic control plane's
+            # churn signal (breaks per wall-clock = regen storm alarm)
+            default_registry().inc("elastic_generation_breaks_total")
+            default_ring().record(obs_trace.GENERATION_BREAK,
+                                  gen=self._gen, reason=reason)
         self._regen_wanted = True
         self._lock.notify_all()
 
@@ -414,6 +423,11 @@ class GroupController:
         self._reg.clear()
         self._regen_wanted = False
         self._barriers.clear()
+        default_registry().inc("elastic_generation_cuts_total")
+        default_registry().set("elastic_generation", self._gen)
+        default_ring().record(obs_trace.GENERATION_CUT, gen=self._gen,
+                              members=hosts, donor=donor,
+                              term_base=term_base)
         self._lock.notify_all()
 
     def _cut_loop(self) -> None:

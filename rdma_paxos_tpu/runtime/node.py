@@ -28,10 +28,14 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from rdma_paxos_tpu.config import ClusterConfig, LogConfig, TimeoutConfig
+from rdma_paxos_tpu.config import (
+    ClusterConfig, LogConfig, MAX_BURST_K, REBASE_STALL_STEPS,
+    TimeoutConfig)
 from rdma_paxos_tpu.consensus.log import (
     EntryType, M_CONN, M_GEN, M_LEN, M_REQID, M_TYPE)
 from rdma_paxos_tpu.consensus.state import Role
+from rdma_paxos_tpu.obs import default as obs_default, trace as obs_trace
+from rdma_paxos_tpu.obs.metrics import LATENCY_BUCKETS_S
 from rdma_paxos_tpu.proxy.proxy import (
     PendingEvent, ProxyServer, ReplayEngine, spec_send_refused_dirty)
 from rdma_paxos_tpu.proxy.stablestore import HardState, StableStore
@@ -107,15 +111,32 @@ class NodeDaemon:
         # when no prior state exists)
         hs = self.hard.load()
         self.hd.restore_hardstate(*(hs if hs is not None else (0, 0, -1)))
+        # per-host daemon: structured signals go to the process-global
+        # obs facade (one daemon per process in deployment, so no
+        # cross-instance mixing); the greppable log file is preserved
+        self.obs = obs_default()
         self.log = ReplicaLog(
-            os.path.join(workdir, f"replica{self.me}.log"))
+            os.path.join(workdir, f"replica{self.me}.log"),
+            replica=self.me, obs=self.obs)
         self.timer = ElectionTimer(timeout_cfg or TimeoutConfig(),
                                    seed=seed + process_id)
         self.last: Optional[Dict] = None
         self._rebase_warned = False
+        # consecutive post-threshold iterations with the gathered
+        # rebase_delta pinned at 0 (a heard-but-lagging row's low head
+        # — the consensus/step.py liveness gap, ADVICE.md #3)
+        self._rebase_stall_steps = 0
+        self.rebase_stalled = 0
 
-    # single multihost burst tier (see iterate) — identical on all hosts
-    BURST_K = 8
+    # single multihost burst tier (see iterate) — identical on all
+    # hosts; == config.MAX_BURST_K, which the rebase-headroom
+    # validation in LogConfig accounts for
+    BURST_K = MAX_BURST_K
+
+    # consecutive zero-delta post-threshold iterations before the
+    # stall is surfaced — shared with SimCluster
+    # (config.REBASE_STALL_STEPS)
+    REBASE_STALL_STEPS = REBASE_STALL_STEPS
 
     @property
     def burst_enabled(self) -> bool:
@@ -357,8 +378,17 @@ class NodeDaemon:
             # precedes apply/ack): a client ack implies the event is in
             # this host's stable store
             self.store.sync()
+        import time as _time
+        _now = _time.perf_counter()
         for ev in releases:
             ev.release(0)
+            self.obs.metrics.observe(
+                "commit_latency_seconds", _now - ev.t0,
+                buckets=LATENCY_BUCKETS_S, replica=self.me)
+        if releases:
+            self.obs.trace.record(obs_trace.PROXY_ACK_RELEASE,
+                                  replica=self.me,
+                                  count=len(releases))
         if not self._is_leader:
             with self._lock:
                 if (self.inflight and self.proxy.spec_mode
@@ -385,6 +415,10 @@ class NodeDaemon:
                 delta = int(rd)
                 self.hd.rebase(delta)
                 self.applied -= delta
+                self._rebase_stall_steps = 0     # re-arm stall detect
+                self.obs.metrics.inc("rebases_total")
+                self.obs.trace.record(obs_trace.REBASE_APPLIED,
+                                      replica=self.me, delta=delta)
                 self.log.info_wtime(
                     "REBASE: offsets dropped by %d (i32 rollover)"
                     % delta)
@@ -400,6 +434,42 @@ class NodeDaemon:
                     "not full-connectivity); offsets are approaching "
                     "the i32 ceiling with no rollover possible"
                     % (int(rd), self.hd._fanout))
+        elif int(res["end"]) >= self.cfg.rebase_threshold:
+            # end crossed the threshold but the gathered delta stayed 0
+            # — a heard-but-lagging row (stalled learner) is pinning
+            # the min head, and the rollover will never fire on its
+            # own. Surface it so operators see the i32 ceiling
+            # approaching in the psum path too (ADVICE.md #3).
+            self._rebase_stall_steps += 1
+            if self._rebase_stall_steps >= self.REBASE_STALL_STEPS:
+                self.rebase_stalled += 1
+                self.obs.metrics.inc("rebase_stalled")
+                if self._rebase_stall_steps == self.REBASE_STALL_STEPS:
+                    self.obs.trace.record(
+                        obs_trace.REBASE_STALLED, replica=self.me,
+                        end=int(res["end"]),
+                        threshold=self.cfg.rebase_threshold,
+                        steps=self._rebase_stall_steps)
+                    self.log.info_wtime(
+                        "REBASE STALLED: end=%d crossed threshold=%d "
+                        "but rebase_delta stayed 0 for %d steps — a "
+                        "lagging heard row is pinning the min head; "
+                        "the i32 ceiling is approaching"
+                        % (int(res["end"]), self.cfg.rebase_threshold,
+                           self._rebase_stall_steps))
+        # per-iteration host gauges (role/term/progress/headroom): the
+        # structured twin of the log file, exported with every snapshot
+        self.obs.metrics.set("replica_role", int(res["role"]),
+                             replica=self.me)
+        self.obs.metrics.set("replica_term", int(res["term"]),
+                             replica=self.me)
+        self.obs.metrics.set("commit_index", commit, replica=self.me)
+        self.obs.metrics.set("rebase_headroom",
+                             self.cfg.rebase_threshold
+                             - int(res["end"]), replica=self.me)
+        with self._lock:
+            self.obs.metrics.set("inflight_waiters", len(self.inflight),
+                                 replica=self.me)
         self.last = res
         return res
 

@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from rdma_paxos_tpu.config import LogConfig
+from rdma_paxos_tpu.config import LogConfig, REBASE_STALL_STEPS
 from rdma_paxos_tpu.consensus.log import (
     EntryType, M_CONN, M_GIDX, M_LEN, M_REQID, M_TYPE, META_W)
 from rdma_paxos_tpu.consensus.state import Role
@@ -105,6 +105,20 @@ class SimCluster:
         # coordinated i32-offset rollovers performed (see _maybe_rebase)
         self.rebases = 0
         self.rebased_total = 0
+        # rebase-stall surfacing (ADVICE.md #3): a heard-but-lagging
+        # row's low head pins the agreed delta at 0, so end marches
+        # toward the i32 ceiling with no rollover possible. Consecutive
+        # post-threshold steps with delta 0 are counted; past
+        # REBASE_STALL_STEPS each further step increments
+        # ``rebase_stalled`` (and the attached registry's counter), and
+        # the transition emits one ``rebase_stalled`` trace event
+        # (re-armed by the next successful rollover).
+        self.rebase_stall_steps = 0
+        self.rebase_stalled = 0
+        # host-side observability facade (rdma_paxos_tpu.obs); attached
+        # by ClusterDriver (or tests). NEVER read inside jitted code —
+        # instrumentation must not change compiled-step cache keys.
+        self.obs = None
 
     # ---------------- client-side API ----------------
 
@@ -353,6 +367,30 @@ class SimCluster:
         self.last = res
         return res
 
+    # consecutive post-threshold zero-delta steps before the stall is
+    # declared — shared with NodeDaemon (config.REBASE_STALL_STEPS)
+    REBASE_STALL_STEPS = REBASE_STALL_STEPS
+
+    def _rebase_stalled_step(self, res) -> None:
+        """One post-threshold step passed with the rollover delta
+        pinned at 0 — count it, and surface the stall once it persists
+        (the i32 ceiling is approaching and nothing will fire)."""
+        self.rebase_stall_steps += 1
+        if self.rebase_stall_steps < self.REBASE_STALL_STEPS:
+            return
+        self.rebase_stalled += 1
+        if self.obs is not None:
+            from rdma_paxos_tpu.obs import trace as _trace
+            self.obs.metrics.inc("rebase_stalled")
+            if self.rebase_stall_steps == self.REBASE_STALL_STEPS:
+                heads = [int(res["head"][r]) for r in range(self.R)]
+                self.obs.trace.record(
+                    _trace.REBASE_STALLED,
+                    end_max=int(res["end"].max()),
+                    threshold=self.cfg.rebase_threshold,
+                    min_head=min(heads), heads=heads,
+                    steps=self.rebase_stall_steps)
+
     def _maybe_rebase(self, res) -> None:
         """Coordinated i32-offset rollover (LogConfig.rebase_threshold):
         when any end offset crosses the threshold, subtract the minimum
@@ -378,9 +416,11 @@ class SimCluster:
         heads = [int(res["head"][r]) for r in range(self.R)
                  if r not in self.need_recovery]
         if not heads:
+            self._rebase_stalled_step(res)
             return
         delta = min(heads) & ~(self.cfg.n_slots - 1)
         if delta <= 0:
+            self._rebase_stalled_step(res)
             return
         from rdma_paxos_tpu.consensus.snapshot import rebase_offsets
         self.state = rebase_offsets(self.state, delta)
@@ -389,6 +429,13 @@ class SimCluster:
             res[k] = res[k] - delta
         self.rebases += 1
         self.rebased_total += delta
+        self.rebase_stall_steps = 0          # re-arm stall detection
+        if self.obs is not None:
+            from rdma_paxos_tpu.obs import trace as _trace
+            self.obs.metrics.inc("rebases_total")
+            self.obs.metrics.inc("rebased_entries_total", delta)
+            self.obs.trace.record(_trace.REBASE_APPLIED, delta=delta,
+                                  rebases=self.rebases)
 
     def _replay_committed(self, res) -> None:
         """Host apply loop: fetch newly committed entries from the device
