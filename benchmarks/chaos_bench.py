@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Chaos bench — nemesis throughput + checker cost for BENCH_* rounds.
+
+Runs one (or several) seeded nemesis schedules through
+``rdma_paxos_tpu.chaos.runner.NemesisRunner`` and reports what a
+perf-PR gate needs: steps/s under fault injection, client ops checked,
+linearizability-search states explored, and the verdict — so later
+optimization rounds can demonstrate "still correct under chaos" with
+one JSON line per seed.
+
+    python benchmarks/chaos_bench.py --seed 7 --replicas 3 --steps 200
+    python benchmarks/chaos_bench.py --seeds 0-9 --replicas 5 --json
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def parse_seeds(spec: str):
+    out = []
+    for part in spec.split(","):
+        if "-" in part:
+            lo, hi = part.split("-")
+            out.extend(range(int(lo), int(hi) + 1))
+        else:
+            out.append(int(part))
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--seed", type=int, default=None)
+    ap.add_argument("--seeds", type=str, default=None,
+                    help="e.g. 0-4 or 1,3,9 (overrides --seed)")
+    ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--steps", type=int, default=150)
+    ap.add_argument("--clients", type=int, default=2)
+    ap.add_argument("--keys", type=int, default=3)
+    ap.add_argument("--json", action="store_true",
+                    help="one JSON result line per seed")
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from rdma_paxos_tpu.chaos.runner import NemesisRunner
+
+    seeds = (parse_seeds(args.seeds) if args.seeds
+             else [args.seed if args.seed is not None else 0])
+    failures = 0
+    for i, seed in enumerate(seeds):
+        t0 = time.perf_counter()
+        runner = NemesisRunner(n_replicas=args.replicas, seed=seed,
+                               steps=args.steps,
+                               n_clients=args.clients,
+                               n_keys=args.keys)
+        verdict = runner.run()
+        dt = time.perf_counter() - t0
+        linz = verdict["linearizability"]
+        states = linz["states"]        # checker search cost, from run()
+        row = dict(
+            seed=seed, replicas=args.replicas, steps=args.steps,
+            ok=verdict["ok"],
+            elapsed_s=round(dt, 3),
+            steps_per_s=round((args.steps + runner.settle_steps) / dt,
+                              1),
+            schedule_events=verdict["schedule_events"],
+            client_ops=verdict["client_ops"],
+            checked_ops=linz["ops"],
+            checker_states=states,
+            invariant_violations=len(verdict["invariant_violations"]),
+            linearizability_ok=linz["ok"],
+            artifact=verdict.get("artifact"),
+            warm=i > 0,     # first seed pays the one-time JIT compile
+        )
+        if args.json:
+            print(json.dumps(row))
+        else:
+            print("seed %3d: %s  %6.2fs (%5.1f steps/s)  ops=%d "
+                  "checked=%d states=%d%s"
+                  % (seed, "OK  " if row["ok"] else "FAIL",
+                     row["elapsed_s"], row["steps_per_s"],
+                     row["client_ops"], row["checked_ops"],
+                     row["checker_states"],
+                     ("  artifact=" + row["artifact"])
+                     if row["artifact"] else ""))
+        if not verdict["ok"]:
+            failures += 1
+    sys.exit(1 if failures else 0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,53 @@
+"""Chaos subsystem: deterministic fault injection + client-visible
+linearizability checking — the Jepsen-style harness the reference never
+had (SURVEY.md §5: its safety argument is design-by-comment).
+
+Five parts, all host-side, all seed-deterministic:
+
+* :mod:`~rdma_paxos_tpu.chaos.faults` — a seeded fault-schedule DSL
+  (nemesis) plus the pluggable per-link model ``SimCluster`` consults
+  each step: asymmetric link breaks, message drop/delay/duplication,
+  crash-restart with volatile-state wipe + snapshot/StableStore-style
+  recovery, and election-timeout jitter.
+* :mod:`~rdma_paxos_tpu.chaos.history` — a client-operation history
+  recorder (invoke/ok/fail/timeout events over logical step time,
+  JSONL dump) hooked into ``ReplicatedKVS``/``ClientSession``,
+  including weak reads and retransmits.
+* :mod:`~rdma_paxos_tpu.chaos.linearize` — a per-key-partitioned
+  Wing–Gong linearizability checker with memoization (porcupine-style)
+  over the KVS register model; timed-out ops are ambiguous (may or may
+  not have taken effect).
+* :mod:`~rdma_paxos_tpu.chaos.invariants` — the I1–I5 protocol safety
+  invariants, extracted from ``tests/test_fuzz.py`` into a reusable
+  checker both the fuzzer and the nemesis runner share.
+* :mod:`~rdma_paxos_tpu.chaos.runner` — the nemesis runner composing
+  workload generator + fault schedule + invariants + the checker; any
+  violation dumps a self-contained reproducer artifact (seed, schedule
+  JSON, history JSONL, obs trace ring, metrics snapshot) via
+  :mod:`~rdma_paxos_tpu.chaos.artifact`.
+
+HARD RULE (same as :mod:`rdma_paxos_tpu.obs`): nothing here may run
+inside jitted/``shard_map``ped code. The link model only rewrites the
+``peer_mask`` INPUT ARRAY the step already takes — compiled-step cache
+keys are bit-identical with chaos on or off (``tests/test_chaos.py``
+guards it).
+"""
+
+from __future__ import annotations
+
+from rdma_paxos_tpu.chaos.artifact import load_reproducer, write_reproducer
+from rdma_paxos_tpu.chaos.faults import (
+    FaultSchedule, HardStateTracker, LinkModel, StepTimerModel,
+    crash_replica, generate_schedule, restart_replica)
+from rdma_paxos_tpu.chaos.history import HistoryRecorder
+from rdma_paxos_tpu.chaos.invariants import (
+    InvariantChecker, InvariantViolation)
+from rdma_paxos_tpu.chaos.linearize import check_history, check_key
+
+__all__ = [
+    "FaultSchedule", "HardStateTracker", "HistoryRecorder",
+    "InvariantChecker", "InvariantViolation", "LinkModel",
+    "StepTimerModel", "check_history", "check_key", "crash_replica",
+    "generate_schedule", "load_reproducer", "restart_replica",
+    "write_reproducer",
+]

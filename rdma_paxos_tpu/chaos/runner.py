@@ -1,0 +1,376 @@
+"""Nemesis runner: workload × fault schedule × invariants × checker.
+
+Composes the whole chaos subsystem against a live ``SimCluster`` +
+``ReplicatedKVS``: a seeded client workload (sessioned PUT/RM with
+retransmit-on-failover and seeded in-flight message duplication,
+linearizable read-index GETs at the leader, weak GETs anywhere) runs
+under a seeded :class:`~rdma_paxos_tpu.chaos.faults.FaultSchedule`
+while every step is checked against the I1–I5 protocol invariants and
+the full client history is recorded; after the run settles, the
+per-key Wing–Gong checker verdicts the client-visible contract.
+
+Determinism: ALL randomness derives from the run seed (schedule,
+workload, link model, timers); time is the logical step counter. The
+same seed therefore yields a byte-identical schedule, history, and
+verdict — the reproducibility contract ``tests/test_chaos.py`` pins.
+
+On any violation the runner dumps a self-contained reproducer artifact
+(seed, schedule JSON, history JSONL, obs trace ring, metrics snapshot)
+and puts its path in the verdict; :meth:`NemesisRunner.replay` re-runs
+an artifact end to end.
+
+Fanout guard (never die mid-run): ``fanout='psum'`` cannot model
+partitions — ``SimCluster.partition()``/non-full masks raise mid-step
+by design. The runner refuses mask-affecting schedules on psum
+clusters AT CONSTRUCTION, or — with ``skip_incompatible_faults=True``
+— strips them with a single warning line and runs the rest.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+from typing import Dict, List, Optional
+
+from rdma_paxos_tpu.chaos import artifact as chaos_artifact
+from rdma_paxos_tpu.chaos.faults import (
+    FaultSchedule, HardStateTracker, LinkModel, StepTimerModel,
+    generate_schedule)
+from rdma_paxos_tpu.chaos.history import HistoryRecorder
+from rdma_paxos_tpu.chaos.invariants import (
+    InvariantChecker, InvariantViolation)
+from rdma_paxos_tpu.chaos.linearize import check_history
+from rdma_paxos_tpu.config import LogConfig
+from rdma_paxos_tpu.consensus.state import Role
+from rdma_paxos_tpu.models.replicated_kvs import ReplicatedKVS
+from rdma_paxos_tpu.obs import Observability, trace as obs_trace
+from rdma_paxos_tpu.runtime.sim import SimCluster
+
+log = logging.getLogger("rdma_paxos_tpu.chaos")
+
+# same geometry as tests/test_replicated_kvs.py so compiled steps are
+# shared across the suite (KVS commands are CMD_W*4 = 68 bytes — they
+# must fit one slot)
+DEFAULT_KV_CFG = LogConfig(n_slots=128, slot_bytes=128,
+                           window_slots=32, batch_slots=16)
+
+
+def _leader_of(res) -> int:
+    """Highest-term self-claimed leader (the driver's view rule): an
+    isolated deposed leader can still claim, but terms are unique per
+    leader by quorum election, so max-term picks the real one."""
+    if res is None:
+        return -1
+    claims = [(int(res["term"][r]), r) for r in range(len(res["role"]))
+              if int(res["role"][r]) == int(Role.LEADER)]
+    return max(claims)[1] if claims else -1
+
+
+class _Workload:
+    """Seeded closed-loop clients over a ReplicatedKVS.
+
+    Each client keeps AT MOST ONE write outstanding (the
+    ``ClientSession`` protocol contract) and retransmits it — to the
+    new leader after a failover — until its commit is observed or the
+    client gives up (→ ambiguous). With probability ``dup_msg_p`` the
+    network duplicates a client message in flight: the copy is
+    re-submitted a few steps later with the SAME ``(client, req_id)``
+    stamp — exactly the hazard the dedup registry exists for, and the
+    signal the linearizability checker uses to catch a broken one."""
+
+    def __init__(self, kv: ReplicatedKVS, history: HistoryRecorder,
+                 seed: int, n_clients: int, n_keys: int, *,
+                 p_write: float = 0.45, p_rm: float = 0.12,
+                 p_read: float = 0.5, p_weak: float = 0.3,
+                 dup_msg_p: float = 0.15, dup_delay: int = 4,
+                 patience: int = 14):
+        self.kv = kv
+        self.h = history
+        self.rng = random.Random(f"workload:{seed}")
+        self.sessions = [kv.session(i + 1) for i in range(n_clients)]
+        self.keys = [b"key%d" % i for i in range(n_keys)]
+        self.outstanding: List[Optional[dict]] = [None] * n_clients
+        self.dup_queue: List[dict] = []   # in-flight duplicated msgs
+        self.p_write, self.p_rm = p_write, p_rm
+        self.p_read, self.p_weak = p_read, p_weak
+        self.dup_msg_p, self.dup_delay = dup_msg_p, dup_delay
+        self.patience = patience
+        self._vn = 0
+
+    # ---- completion observation (after the step) ----
+
+    def observe(self, t: int, leader: int) -> None:
+        if leader < 0:
+            return
+        self.kv._fold(leader)
+        marks = self.kv.last_req[leader]
+        for ci, out in enumerate(self.outstanding):
+            if out is None:
+                continue
+            if marks.get(out["client"], 0) >= out["req_id"]:
+                self.h.ok(out["op_id"])
+                self.outstanding[ci] = None
+
+    # ---- issue phase (before the step) ----
+
+    def _submit(self, sess, leader: int, out: dict) -> None:
+        if out["kind"] == "put":
+            self.kv.put(leader, out["key"], out["val"],
+                        client_id=out["client"], req_id=out["req_id"])
+        else:
+            self.kv.remove(leader, out["key"],
+                           client_id=out["client"],
+                           req_id=out["req_id"])
+
+    def _maybe_dup(self, t: int, out: dict) -> None:
+        if self.rng.random() < self.dup_msg_p:
+            self.dup_queue.append(dict(
+                at=t + self.rng.randint(1, self.dup_delay), **out))
+
+    def issue(self, t: int, leader: int, down) -> None:
+        # network-duplicated copies land at whatever leader now rules
+        due = [d for d in self.dup_queue if d["at"] <= t]
+        self.dup_queue = [d for d in self.dup_queue if d["at"] > t]
+        for d in due:
+            if leader >= 0:
+                self._submit(None, leader, d)
+                self.h.retransmit(d["op_id"], replica=leader,
+                                  network_dup=True)
+        for ci, sess in enumerate(self.sessions):
+            out = self.outstanding[ci]
+            if out is not None:
+                if t - out["issued"] > self.patience:
+                    # fate unknown — ambiguous for the checker
+                    self.h.timeout(out["op_id"])
+                    self.outstanding[ci] = None
+                elif leader >= 0 and leader != out["to"]:
+                    # failover: retransmit the SAME req_id elsewhere
+                    out["to"] = leader
+                    self._submit(sess, leader, out)
+                    self.h.retransmit(out["op_id"], replica=leader)
+                    self._maybe_dup(t, out)
+                out = self.outstanding[ci]
+            if out is None and leader >= 0 \
+                    and self.rng.random() < self.p_write:
+                key = self.rng.choice(self.keys)
+                if self.rng.random() < self.p_rm:
+                    rid = sess.remove(leader, key)
+                    kind, val = "rm", None
+                else:
+                    self._vn += 1
+                    val = b"c%dv%d" % (sess.client_id, self._vn)
+                    rid = sess.put(leader, key, val)
+                    kind = "put"
+                op_id = self.h.op_id_for(sess.client_id, rid)
+                rec = dict(op_id=op_id, kind=kind, key=key, val=val,
+                           client=sess.client_id, req_id=rid,
+                           to=leader, issued=t)
+                self.outstanding[ci] = rec
+                self._maybe_dup(t, rec)
+        # reads: the linearizable path self-records ok/fail via the
+        # history hook in ReplicatedKVS.get
+        if leader >= 0 and self.rng.random() < self.p_read:
+            self.kv.get(leader, self.rng.choice(self.keys),
+                        linearizable=True)
+        if self.rng.random() < self.p_weak:
+            live = [r for r in range(self.kv.c.R) if r not in down]
+            if live:
+                self.kv.get(self.rng.choice(live),
+                            self.rng.choice(self.keys))
+
+    def finish(self) -> None:
+        """Run end: every still-unresolved op is ambiguous."""
+        for out in self.outstanding:
+            if out is not None:
+                self.h.timeout(out["op_id"])
+        for op_id in self.h.pending():
+            self.h.timeout(op_id)
+
+
+class NemesisRunner:
+    """One seeded chaos run over a fresh in-process cluster."""
+
+    def __init__(self, cfg: Optional[LogConfig] = None,
+                 n_replicas: int = 3, *, seed: int = 0,
+                 steps: int = 120, schedule: Optional[FaultSchedule]
+                 = None, fault_kinds=("partition", "crash", "drop",
+                                      "delay", "dup", "skew"),
+                 n_clients: int = 2, n_keys: int = 3,
+                 workload_opts: Optional[dict] = None,
+                 fanout: str = "gather", kvs_cap: int = 256,
+                 settle_steps: int = 30,
+                 artifact_path: Optional[str] = None,
+                 skip_incompatible_faults: bool = False,
+                 obs: Optional[Observability] = None):
+        self.cfg = cfg or DEFAULT_KV_CFG
+        self.R = int(n_replicas)
+        self.seed = int(seed)
+        self.steps = int(steps)
+        self.settle_steps = int(settle_steps)
+        self.artifact_path = artifact_path
+        self.workload_opts = dict(workload_opts or {})
+        self.obs = obs if obs is not None else Observability()
+        if schedule is None:
+            schedule = generate_schedule(seed, self.R, steps,
+                                         kinds=fault_kinds)
+        schedule.validate(self.R)
+        # fanout guard — up front, never mid-run (see module docstring)
+        if fanout == "psum" and schedule.mask_affecting():
+            if not skip_incompatible_faults:
+                raise ValueError(
+                    "fanout='psum' cannot model partitions/crashes/"
+                    "link faults (single-contributor broadcast needs "
+                    "full connectivity); build with fanout='gather' "
+                    "or pass skip_incompatible_faults=True")
+            n_dropped = len(schedule.mask_affecting())
+            schedule = schedule.without_mask_faults()
+            log.warning(
+                "chaos: fanout='psum' — skipping %d mask-affecting "
+                "fault(s) (partition/crash/drop/delay need 'gather')",
+                n_dropped)
+        self.schedule = schedule
+        self.cluster = SimCluster(self.cfg, self.R, fanout=fanout)
+        self.cluster.obs = self.obs
+        self.link = LinkModel(self.R, seed=seed)
+        self.link.obs = self.obs
+        self.cluster.link_model = self.link
+        self.kv = ReplicatedKVS(self.cluster, cap=kvs_cap)
+        self.history = HistoryRecorder()
+        self.kv.history = self.history
+        self.hard = HardStateTracker(self.R)
+        self.timers = StepTimerModel(self.R, seed=seed)
+        self.invariants = InvariantChecker(self.R)
+        self.workload = _Workload(self.kv, self.history, seed,
+                                  n_clients, n_keys,
+                                  **self.workload_opts)
+        self.n_clients, self.n_keys = n_clients, n_keys
+        self.fanout = fanout
+
+    # ------------------------------------------------------------------
+
+    def _config_doc(self) -> dict:
+        return dict(
+            log=dict(n_slots=self.cfg.n_slots,
+                     slot_bytes=self.cfg.slot_bytes,
+                     window_slots=self.cfg.window_slots,
+                     batch_slots=self.cfg.batch_slots,
+                     rebase_threshold=self.cfg.rebase_threshold),
+            n_replicas=self.R, steps=self.steps,
+            settle_steps=self.settle_steps, fanout=self.fanout,
+            n_clients=self.n_clients, n_keys=self.n_keys,
+            workload_opts=self.workload_opts)
+
+    def _one_step(self, t: int, leader: int,
+                  violations: List[dict]) -> int:
+        self.history.set_clock(t)
+        fired = self.schedule.apply(t, self.cluster, self.link,
+                                    timers=self.timers, hard=self.hard,
+                                    kvs=self.kv)
+        for ev in fired:
+            if ev["op"] == "restart":
+                self.invariants.reset_replica(ev["replica"])
+        self.workload.issue(t, leader, self.link.down)
+        timeouts = self.timers.fire(self.link.down)
+        res = self.cluster.step(timeouts=timeouts)
+        self.hard.observe(res)
+        self.timers.observe(res)
+        try:
+            self.invariants.check_step(
+                res, step=t, rebased_total=self.cluster.rebased_total)
+        except InvariantViolation as v:
+            violations.append(v.as_dict())
+            self.obs.trace.record(obs_trace.NEMESIS_VIOLATION,
+                                  **v.as_dict())
+        leader = _leader_of(res)
+        self.workload.observe(t, leader)
+        return leader
+
+    def run(self) -> Dict:
+        """Execute the schedule, settle, check. Returns the verdict
+        dict (deterministic for a given seed: no wall-clock fields);
+        writes a reproducer artifact when anything failed."""
+        violations: List[dict] = []
+        leader = -1
+        for t in range(self.steps):
+            leader = self._one_step(t, leader, violations)
+            if violations:
+                break
+        # settle: clear faults, revive the dead, let the cluster
+        # converge so the convergence invariant and pending ops resolve
+        self.history.set_clock(self.steps)
+        self.link.heal()
+        if not violations:
+            from rdma_paxos_tpu.chaos.faults import restart_replica
+            for r in sorted(self.link.down):
+                restart_replica(self.cluster, r, self.link,
+                                hard=self.hard, kvs=self.kv)
+                self.invariants.reset_replica(r)
+            for t in range(self.steps, self.steps + self.settle_steps):
+                leader = self._one_step(t, leader, violations)
+                if violations:
+                    break
+        self.workload.finish()
+        if not violations:
+            try:
+                self.invariants.check_convergence(self.cluster.replayed)
+            except InvariantViolation as v:
+                violations.append(v.as_dict())
+        linz = check_history(self.history.ops())
+        ok = not violations and linz["ok"] is True
+        verdict: Dict = dict(
+            ok=ok, seed=self.seed, steps=self.steps,
+            schedule_events=len(self.schedule),
+            invariant_violations=violations,
+            linearizability=dict(ok=linz["ok"],
+                                 violations=linz["violations"],
+                                 undecided=linz["undecided"],
+                                 ops=linz["ops"],
+                                 states=linz["states"]),
+            history_events=len(self.history),
+            client_ops=len(self.history.ops(include_weak=True)),
+        )
+        if not ok:
+            # ok=None (state budget exceeded) is NOT a found violation —
+            # label it honestly so nobody chases a bug that was never
+            # detected; the artifact still ships for a deeper re-check
+            reason = ("invariant violation" if violations
+                      else "linearizability violation"
+                      if linz["violations"]
+                      else "linearizability undecided "
+                           "(checker state budget exceeded)")
+            verdict["artifact"] = chaos_artifact.write_reproducer(
+                self.artifact_path, seed=self.seed,
+                schedule=self.schedule, reason=reason,
+                config=self._config_doc(),
+                history=self.history.to_jsonl(),
+                violation=dict(invariants=violations,
+                               linearizability={
+                                   "violations": linz["violations"],
+                                   "undecided": linz["undecided"]}),
+                obs=self.obs, extra={"verdict": {
+                    k: v for k, v in verdict.items()
+                    if k != "artifact"}})
+        return verdict
+
+    # ------------------------------------------------------------------
+
+    @classmethod
+    def replay(cls, path: str, **overrides) -> Dict:
+        """Re-run a reproducer artifact: same seed, same schedule, same
+        config — the deterministic harness reproduces the same history
+        and verdict (the whole point of the artifact)."""
+        doc = chaos_artifact.load_reproducer(path)
+        cfg_doc = doc["config"]
+        kw = dict(
+            cfg=LogConfig(**cfg_doc["log"]),
+            n_replicas=cfg_doc["n_replicas"],
+            seed=doc["seed"], steps=cfg_doc["steps"],
+            settle_steps=cfg_doc.get("settle_steps", 30),
+            schedule=FaultSchedule(doc["schedule"]),
+            fanout=cfg_doc.get("fanout", "gather"),
+            n_clients=cfg_doc.get("n_clients", 2),
+            n_keys=cfg_doc.get("n_keys", 3),
+            workload_opts=cfg_doc.get("workload_opts") or {},
+        )
+        kw.update(overrides)
+        return cls(**kw).run()

@@ -47,6 +47,25 @@ class ReplicatedKVS:
         # (dare_ibv_ud.c:1004-1014 dedups the same way at the leader).
         self.last_req: List[dict] = [dict() for _ in range(cluster.R)]
         self.deduped: List[int] = [0] * cluster.R
+        # optional chaos.history.HistoryRecorder: when attached, every
+        # client-visible operation (session PUT/RM, weak and read-index
+        # GETs, retransmits) is recorded as invoke/ok/fail events for
+        # the linearizability checker. Host-side bookkeeping only.
+        self.history = None
+
+    # ------------------------------------------------------------------
+
+    def rebuild(self, r: int) -> None:
+        """Crash-restart of replica ``r``'s app process: discard the
+        device table and dedup registry (volatile) and refold from the
+        replayed stream (the StableStore analog — replay IS the
+        driver's recovery path). The fold is deterministic, so the
+        rebuilt table, registry, and dedup decisions match exactly what
+        the pre-crash incarnation derived."""
+        self.tables[r] = make_kvs(int(self.tables[r].cap))
+        self._cursor[r] = 0
+        self.last_req[r] = dict()
+        self.deduped[r] = 0
 
     # ------------------------------------------------------------------
 
@@ -90,15 +109,26 @@ class ReplicatedKVS:
         """Read from replica ``r``'s table. With ``linearizable=True`` the
         read is refused (returns None) unless ``r`` verified leadership on
         the latest step — the read-index rule."""
+        op_id = (self.history.invoke("get", key, replica=r,
+                                     weak=not linearizable)
+                 if self.history is not None else None)
         if linearizable:
             last = self.c.last
             if last is None or not last["leadership_verified"][r]:
+                # a REFUSED read definitively did not happen — fail,
+                # not timeout (the checker drops it, constraint-free)
+                if op_id is not None:
+                    self.history.fail(op_id,
+                                      reason="leadership_unverified")
                 return None
         self._fold(r)
         _, out = self._apply_jit(self.tables[r],
                                  jnp.asarray(encode_cmd(OP_GET, key)))
         v = decode_val(np.asarray(out))
-        return v if v else None
+        v = v if v else None
+        if op_id is not None:
+            self.history.ok(op_id, v)
+        return v
 
 
 class ClientSession:
@@ -133,12 +163,19 @@ class ClientSession:
     def put(self, leader: int, key: bytes, val: bytes) -> int:
         """Submit a PUT; returns its req_id (keep it to retransmit)."""
         self.req_id += 1
+        if self.kvs.history is not None:
+            self.kvs.history.invoke("put", key, val,
+                                    client=self.client_id,
+                                    req_id=self.req_id, replica=leader)
         self.kvs.put(leader, key, val, client_id=self.client_id,
                      req_id=self.req_id)
         return self.req_id
 
     def remove(self, leader: int, key: bytes) -> int:
         self.req_id += 1
+        if self.kvs.history is not None:
+            self.kvs.history.invoke("rm", key, client=self.client_id,
+                                    req_id=self.req_id, replica=leader)
         self.kvs.remove(leader, key, client_id=self.client_id,
                         req_id=self.req_id)
         return self.req_id
@@ -147,5 +184,9 @@ class ClientSession:
                        req_id: int) -> None:
         """Resend an earlier PUT verbatim (client saw no ack — e.g. the
         leader died after commit). Safe to call any number of times."""
+        if self.kvs.history is not None:
+            op_id = self.kvs.history.op_id_for(self.client_id, req_id)
+            if op_id is not None:
+                self.kvs.history.retransmit(op_id, replica=leader)
         self.kvs.put(leader, key, val, client_id=self.client_id,
                      req_id=req_id)

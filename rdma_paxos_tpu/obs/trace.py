@@ -47,6 +47,9 @@ GENERATION_CUT = "generation_cut"        # elastic world cut
 GENERATION_BREAK = "generation_break"    # elastic world broken
 STOP_FORCED = "stop_forced"              # stop() with a wedged poll thread
 LOG_LINE = "log"                         # routed ReplicaLog event line
+FAULT_INJECTED = "fault_injected"        # chaos nemesis fault applied
+CRASH_RESTART = "crash_restart"          # chaos crash-restart recovery ran
+NEMESIS_VIOLATION = "nemesis_violation"  # chaos invariant/linearize failure
 
 
 class TraceEvent(NamedTuple):

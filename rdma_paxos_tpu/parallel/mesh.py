@@ -30,6 +30,21 @@ from rdma_paxos_tpu.consensus.step import StepInput, replica_step
 REPLICA_AXIS = "replica"
 
 
+def _shard_map(f, *, mesh: Mesh, in_specs, out_specs):
+    """Version-portable shard_map: ``jax.shard_map`` (with its
+    ``check_vma`` knob) on new JAX, ``jax.experimental.shard_map``
+    (``check_rep``) on older installs — same semantics, replication
+    checking off in both (the step's outputs are per-replica by
+    construction)."""
+    try:
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    except (AttributeError, TypeError):
+        from jax.experimental.shard_map import shard_map as sm
+        return sm(f, mesh=mesh, in_specs=in_specs,
+                  out_specs=out_specs, check_rep=False)
+
+
 def make_replica_mesh(n_replicas: int,
                       devices: Optional[Sequence] = None) -> Mesh:
     """1-D mesh with one consensus replica per device."""
@@ -78,11 +93,10 @@ def build_spmd_step(cfg: LogConfig, n_replicas: int, mesh: Mesh, *,
         st, out = core(_squeeze(state_b), _squeeze(inp_b))
         return _unsqueeze(st), _unsqueeze(out)
 
-    mapped = jax.shard_map(
+    mapped = _shard_map(
         per_device, mesh=mesh,
         in_specs=(P(REPLICA_AXIS), P(REPLICA_AXIS)),
-        out_specs=(P(REPLICA_AXIS), P(REPLICA_AXIS)),
-        check_vma=False)
+        out_specs=(P(REPLICA_AXIS), P(REPLICA_AXIS)))
     return jax.jit(mapped, donate_argnums=(0,) if donate else ())
 
 
@@ -172,13 +186,12 @@ def build_spmd_burst(cfg: LogConfig, n_replicas: int, mesh: Mesh, *,
         return (_unsqueeze(st),
                 jax.tree.map(lambda x: x[:, None], outs))   # [K, 1, ...]
 
-    mapped = jax.shard_map(
+    mapped = _shard_map(
         per_device, mesh=mesh,
         in_specs=(P(REPLICA_AXIS), P(None, REPLICA_AXIS),
                   P(None, REPLICA_AXIS), P(None, REPLICA_AXIS),
                   P(REPLICA_AXIS), P(REPLICA_AXIS), P(REPLICA_AXIS)),
-        out_specs=(P(REPLICA_AXIS), P(None, REPLICA_AXIS)),
-        check_vma=False)
+        out_specs=(P(REPLICA_AXIS), P(None, REPLICA_AXIS)))
     return jax.jit(mapped, donate_argnums=(0,) if donate else ())
 
 

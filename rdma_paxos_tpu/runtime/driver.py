@@ -105,7 +105,7 @@ class ClusterDriver:
                  sync_period: float = 0.05, step_down_steps: int = 50,
                  app_snapshot=None, fanout: str = "gather",
                  obs: Optional[Observability] = None,
-                 health_period: float = 0.5):
+                 health_period: float = 0.5, link_model=None):
         self.cfg = cfg
         self.sync_period = sync_period
         self._workdir = workdir
@@ -153,6 +153,15 @@ class ClusterDriver:
         self.cluster = SimCluster(cfg, n_replicas, group_size, mode=mode,
                                   fanout=fanout)
         self.cluster.obs = self.obs
+        # chaos hook: a per-link fault model (chaos.faults.LinkModel)
+        # driven from outside the poll loop — fault-injection drills
+        # against a LIVE driver (apps + stores + poll thread), not just
+        # the bare sim. Host-side data rewrite only; with fanout="psum"
+        # any non-full mask is rejected by the step, so chaos drills
+        # require the default "gather".
+        if link_model is not None:
+            link_model.obs = self.obs
+            self.cluster.link_model = link_model
         # absolute (rebase-corrected) commit cursor per replica, for the
         # committed_entries_total counters / commit_advance traces
         self._prev_commit_abs = np.zeros(n_replicas, np.int64)

@@ -715,13 +715,21 @@ class ElasticSupervisor:
             argv += ["--cfg-json", self.cfg_json]
         log = open(os.path.join(self.workdir,
                                 f"worker_h{self.host_id}.log"), "ab")
-        self._child = subprocess.Popen(argv, env=env, stdout=log,
-                                       stderr=subprocess.STDOUT)
+        # keep a LOCAL handle: stop()/_reap() null self._child from
+        # another thread, and dereferencing the attribute mid-wait was
+        # a use-after-null crash (AttributeError spew on teardown)
+        child = subprocess.Popen(argv, env=env, stdout=log,
+                                 stderr=subprocess.STDOUT)
+        self._child = child
         log.close()
+        if self._stop.is_set():
+            # stop() raced the Popen: its kill() saw _child as None, so
+            # nothing would ever reap this worker — kill it here
+            child.kill()
         if self.app_port:
             deadline = time.monotonic() + 120
             while (not os.path.exists(sock_path)
-                   and self._child.poll() is None
+                   and child.poll() is None
                    and time.monotonic() < deadline):
                 time.sleep(0.05)
             if os.path.exists(sock_path):
@@ -745,10 +753,12 @@ class ElasticSupervisor:
                       flush=True)
 
     def _reap(self) -> None:
-        if self._app is not None:
-            self._app.kill()
-            self._app.wait()
-            self._app = None
+        # swap-then-use: stop() and the run thread both reap; a local
+        # handle makes the pair idempotent and race-free
+        app, self._app = self._app, None
+        if app is not None:
+            app.kill()
+            app.wait()
         self._child = None
 
     def run(self) -> None:
@@ -786,11 +796,15 @@ class ElasticSupervisor:
             try:
                 self._prepare(spec)
                 self._spawn(spec)
-                rc = self._child.wait()
+                child = self._child
+                rc = child.wait() if child is not None else -1
             except Exception:
-                import traceback
-                traceback.print_exc()
                 rc = -1
+                if not self._stop.is_set():
+                    # a stop() racing the spawn is an expected shutdown
+                    # path, not a fault — only real failures may print
+                    import traceback
+                    traceback.print_exc()
             finally:
                 self._reap()
             if rc != 0 and not self._stop.is_set():
@@ -803,8 +817,12 @@ class ElasticSupervisor:
 
     def stop(self) -> None:
         self._stop.set()
-        if self._child is not None:
-            self._child.kill()
+        # local handle: the run thread's _reap() may null the attribute
+        # between a check and the kill (the same use-after-null class
+        # fixed in _spawn) — read once, then act on the copy
+        child = self._child
+        if child is not None:
+            child.kill()
         self._reap()
         try:
             self._srv.close()

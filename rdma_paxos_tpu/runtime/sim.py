@@ -119,6 +119,15 @@ class SimCluster:
         # by ClusterDriver (or tests). NEVER read inside jitted code —
         # instrumentation must not change compiled-step cache keys.
         self.obs = None
+        # pluggable per-link fault model (rdma_paxos_tpu.chaos.faults
+        # .LinkModel): when attached, each step's peer_mask INPUT is
+        # rewritten host-side into the effective hear-matrix
+        # (asymmetric breaks, seeded drop/delay/dup, crashed
+        # replicas). Purely a data rewrite — compiled-step cache keys
+        # are unchanged (tests/test_chaos.py guards it). step_index is
+        # the logical clock the model's per-step randomness keys on.
+        self.link_model = None
+        self.step_index = 0
 
     # ---------------- client-side API ----------------
 
@@ -160,9 +169,19 @@ class SimCluster:
 
     # ---------------- stepping ----------------
 
+    def _effective_mask(self):
+        """The step's hear-matrix: the base peer_mask, refined by the
+        attached link model (host-side only; psum fan-out still
+        requires the EFFECTIVE mask to be full)."""
+        if self.link_model is None:
+            return self.peer_mask
+        return self.link_model.effective_mask(self.peer_mask,
+                                              self.step_index)
+
     def _build_inputs(self, timeouts: Sequence[int]) -> StepInput:
         cfg, R = self.cfg, self.R
-        if self._fanout == "psum" and not self.peer_mask.all():
+        mask = self._effective_mask()
+        if self._fanout == "psum" and not mask.all():
             raise ValueError(
                 "psum fan-out requires full connectivity; use "
                 "fanout='gather' to model partitions")
@@ -189,7 +208,7 @@ class SimCluster:
             batch_meta=jnp.asarray(meta),
             batch_count=jnp.asarray(count),
             timeout_fired=jnp.asarray(tmo),
-            peer_mask=jnp.asarray(self.peer_mask),
+            peer_mask=jnp.asarray(mask),
             apply_done=jnp.asarray(self.applied.astype(np.int32)),
             queue_depth=jnp.asarray(
                 np.array([len(q) for q in self.pending], np.int32)),
@@ -256,10 +275,19 @@ class SimCluster:
             for k in range(K):
                 count[k, r] = max(0, min(take_n[r] - k * B, B))
 
+        # one effective mask covers the whole fused burst (the link
+        # model's granularity is a dispatch, not an inner step); the
+        # logical clock still advances by K so per-step randomness
+        # never replays across dispatches
+        mask = self._effective_mask()
+        if self._fanout == "psum" and not mask.all():
+            raise ValueError(
+                "psum fan-out requires full connectivity; use "
+                "fanout='gather' to model partitions")
         fn = self._burst_fn(K)
         self.state, outs = fn(self.state, jnp.asarray(data),
                               jnp.asarray(meta), jnp.asarray(count),
-                              jnp.asarray(self.peer_mask),
+                              jnp.asarray(mask),
                               jnp.asarray(self.applied.astype(np.int32)),
                               jnp.asarray(np.array(
                                   [len(q) for q in self.pending],
@@ -288,6 +316,7 @@ class SimCluster:
         self._replay_committed(res)
         self._maybe_rebase(res)
         self.last = res
+        self.step_index += K
         return res
 
     def _build_step(self, *, elections: bool):
@@ -365,6 +394,7 @@ class SimCluster:
         self._replay_committed(res)
         self._maybe_rebase(res)
         self.last = res
+        self.step_index += 1
         return res
 
     # consecutive post-threshold zero-delta steps before the stall is

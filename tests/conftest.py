@@ -21,3 +21,19 @@ import jax  # noqa: E402
 # force jax_platforms; tests always run on the virtual CPU mesh.
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_threefry_partitionable", True)
+
+
+def jax_multiprocess_cpu() -> bool:
+    """True when this jax/jaxlib can run CROSS-PROCESS collectives on
+    the CPU backend (jax.distributed + gloo CPU collectives). jaxlib
+    0.4.x CPU raises ``XlaRuntimeError: Multiprocess computations
+    aren't implemented on the CPU backend`` the moment a sharded
+    device_put crosses process boundaries — the multi-process
+    deployment tests (multihost, elastic worker worlds) gate on this
+    so an older-jax environment skips them instead of burning their
+    full boot timeouts and failing."""
+    try:
+        ver = tuple(int(x) for x in jax.__version__.split(".")[:2])
+    except ValueError:
+        return True
+    return ver >= (0, 5)

@@ -116,8 +116,11 @@ def test_checkpoint_compaction_keeps_rejoin_cost_flat(tmp_path):
     try:
         driver = ClusterDriver(
             CFG, 3, workdir=str(tmp_path), app_ports=PORTS,
-            timeout_cfg=TimeoutConfig(elec_timeout_low=0.4,
-                                      elec_timeout_high=0.8),
+            # wide timeouts: no mid-test election is intended, and a
+            # slow host's long driver iteration must not trigger a
+            # spurious deposition that severs the drill's sessions
+            timeout_cfg=TimeoutConfig(elec_timeout_low=2.0,
+                                      elec_timeout_high=4.0),
             app_snapshot=(toy_dump, toy_restore, toy_probe))
         for r, port in enumerate(PORTS):
             apps.append(spawn_app(tmp_path, r, port))
@@ -224,8 +227,11 @@ def test_checkpoint_quiesce_fallback_without_probe(tmp_path):
     try:
         driver = ClusterDriver(
             CFG, 3, workdir=str(tmp_path), app_ports=ports,
-            timeout_cfg=TimeoutConfig(elec_timeout_low=0.4,
-                                      elec_timeout_high=0.8),
+            # wide timeouts: no mid-test election is intended, and a
+            # slow host's long driver iteration must not trigger a
+            # spurious deposition that severs the drill's sessions
+            timeout_cfg=TimeoutConfig(elec_timeout_low=2.0,
+                                      elec_timeout_high=4.0),
             app_snapshot=(toy_dump, toy_restore))   # NO probe
         for r, port in enumerate(ports):
             apps.append(spawn_app(tmp_path, r, port))

@@ -1,0 +1,576 @@
+"""Chaos subsystem (rdma_paxos_tpu.chaos): link-model/fault-DSL/
+history/linearizability units plus the integration contracts:
+
+* a seeded nemesis run is BIT-reproducible: same seed ⇒ same schedule,
+  same history JSONL, same verdict;
+* a short smoke schedule (tier-1, no ``slow`` marker) runs clean —
+  invariants hold and the client history linearizes — under
+  partitions, crash-restarts, drops, delays, duplication, and timer
+  skew;
+* an injected dedup bug (test-only monkeypatch of the fold) is CAUGHT
+  by the linearizability checker and produces a replayable reproducer
+  artifact;
+* ``retransmit_put`` dedup survives leader failover AND crash-restart
+  (the ``last_req`` registry rebuilds identically from the store);
+* crash-restart wipes the volatile uncommitted suffix (the crash
+  semantics that make the nemesis meaningful);
+* the nemesis runner refuses/strips psum-incompatible schedules at
+  construction — never mid-run;
+* compiled-step cache keys are unchanged by the link model and chaos
+  instrumentation (host-side-only guard, same style as test_obs).
+"""
+
+import json
+import logging
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rdma_paxos_tpu.chaos.artifact import load_reproducer, write_reproducer
+from rdma_paxos_tpu.chaos.faults import (
+    FaultSchedule, HardStateTracker, LinkModel, StepTimerModel,
+    crash_replica, generate_schedule, restart_replica)
+from rdma_paxos_tpu.chaos.history import HistoryRecorder
+from rdma_paxos_tpu.chaos.invariants import (
+    InvariantChecker, InvariantViolation)
+from rdma_paxos_tpu.chaos.linearize import check_history, check_key
+from rdma_paxos_tpu.chaos.runner import DEFAULT_KV_CFG, NemesisRunner
+from rdma_paxos_tpu.consensus.log import EntryType
+from rdma_paxos_tpu.consensus.state import Role
+from rdma_paxos_tpu.models.kvs import CMD_W
+from rdma_paxos_tpu.models.replicated_kvs import ReplicatedKVS
+from rdma_paxos_tpu.obs import Observability
+from rdma_paxos_tpu.runtime.sim import SimCluster
+
+KVCFG = DEFAULT_KV_CFG
+
+
+# ---------------------------------------------------------------------------
+# link model
+# ---------------------------------------------------------------------------
+
+def _full(n):
+    return np.ones((n, n), np.int32)
+
+
+def test_link_model_asymmetric_block():
+    lm = LinkModel(3, seed=0)
+    lm.block(0, 1)                       # 0 cannot hear 1
+    m = lm.effective_mask(_full(3), 0)
+    assert m[0, 1] == 0 and m[1, 0] == 1     # asymmetric
+    lm.unblock(0, 1)
+    assert lm.effective_mask(_full(3), 0).all()
+
+
+def test_link_model_drop_is_seed_deterministic():
+    lm = LinkModel(4, seed=9)
+    lm.set_drop(0.5)
+    m1 = lm.effective_mask(_full(4), 17)
+    m2 = lm.effective_mask(_full(4), 17)
+    assert (m1 == m2).all()              # pure in (state, step)
+    assert (np.diag(m1) == 1).all()      # self-hearing survives
+    # a different seed disagrees somewhere over a few steps
+    lm2 = LinkModel(4, seed=10)
+    lm2.set_drop(0.5)
+    assert any(
+        (lm.effective_mask(_full(4), t)
+         != lm2.effective_mask(_full(4), t)).any() for t in range(16))
+
+
+def test_link_model_delay_is_periodic():
+    lm = LinkModel(3, seed=0)
+    lm.set_delay(2, dst=0, src=1)        # delivers every 3rd step
+    hears = [lm.effective_mask(_full(3), t)[0, 1] for t in range(9)]
+    assert hears == [0, 0, 1, 0, 0, 1, 0, 0, 1]
+
+
+def test_link_model_down_overrides_everything():
+    lm = LinkModel(3, seed=0)
+    lm.set_dup(1.0)                      # forced deliveries everywhere
+    lm.down.add(2)
+    m = lm.effective_mask(_full(3), 0)
+    assert m[2, 0] == 0 and m[0, 2] == 0 and m[2, 2] == 1
+
+
+def test_link_model_partition_composes_and_heals():
+    lm = LinkModel(4, seed=0)
+    lm.partition([[0, 1], [2, 3]])
+    m = lm.effective_mask(_full(4), 0)
+    assert m[0, 1] == 1 and m[0, 2] == 0 and m[2, 0] == 0
+    lm.heal()
+    assert lm.effective_mask(_full(4), 0).all()
+    # unlisted replicas are ISOLATED singletons — identical semantics
+    # to SimCluster.partition(), so schedules mean the same fault
+    # under either API
+    lm.partition([[0]])
+    m = lm.effective_mask(_full(4), 0)
+    assert m[1, 2] == 0 and m[2, 1] == 0 and m[1, 3] == 0
+    assert (np.diag(m) == 1).all()
+
+
+# ---------------------------------------------------------------------------
+# fault-schedule DSL
+# ---------------------------------------------------------------------------
+
+def test_schedule_validates_structure():
+    with pytest.raises(ValueError, match="unknown fault op"):
+        FaultSchedule().at(0, "meteor")
+    with pytest.raises(ValueError, match="missing kwargs"):
+        FaultSchedule().at(0, "crash")
+    s = FaultSchedule().at(3, "crash", replica=7)
+    with pytest.raises(ValueError, match="out of range"):
+        s.validate(3)
+    with pytest.raises(ValueError, match="not down"):
+        FaultSchedule().at(0, "restart", replica=1).validate(3)
+
+
+def test_schedule_rejects_majority_crash():
+    s = (FaultSchedule()
+         .at(0, "crash", replica=0)
+         .at(1, "crash", replica=1))
+    with pytest.raises(ValueError, match="at most 1"):
+        s.validate(3)
+    # sequential (restart between) is fine
+    s2 = (FaultSchedule()
+          .at(0, "crash", replica=0)
+          .at(2, "restart", replica=0)
+          .at(4, "crash", replica=1)
+          .at(6, "restart", replica=1))
+    s2.validate(3)
+
+
+def test_schedule_json_round_trip_and_generation_determinism():
+    s1 = generate_schedule(42, 5, 120)
+    s2 = generate_schedule(42, 5, 120)
+    assert s1.to_json() == s2.to_json()
+    assert len(s1) > 0
+    assert FaultSchedule.from_json(s1.to_json()).to_json() == s1.to_json()
+    assert generate_schedule(43, 5, 120).to_json() != s1.to_json()
+
+
+# ---------------------------------------------------------------------------
+# history recorder
+# ---------------------------------------------------------------------------
+
+def test_history_records_and_round_trips():
+    h = HistoryRecorder()
+    h.set_clock(1)
+    w = h.invoke("put", b"k", b"v\xff", client=3, req_id=1, replica=0)
+    r1 = h.invoke("get", b"k", replica=1, weak=True)
+    h.ok(r1, None)
+    h.set_clock(2)
+    h.retransmit(w, replica=2)
+    h.ok(w)
+    r2 = h.invoke("get", b"k", replica=0)
+    h.fail(r2, reason="leadership_unverified")
+    dangling = h.invoke("put", b"k", b"v2", client=3, req_id=2)
+    assert h.pending() == [dangling]
+    h.timeout(dangling)
+    assert h.op_id_for(3, 1) == w
+    ops = h.ops()                         # weak excluded by default
+    assert [o["op_id"] for o in ops] == [w, r2, dangling]
+    assert len(h.ops(include_weak=True)) == 4
+    # non-UTF8 bytes survive the JSONL round trip exactly
+    h2 = HistoryRecorder.from_jsonl(h.to_jsonl())
+    assert h2.to_jsonl() == h.to_jsonl()
+    assert h2.ops() == h.ops()
+    rec = h2.op(w)
+    assert rec["value"].encode("latin-1") == b"v\xff"
+    assert rec["inv"] == 1 and rec["res"] == 2 and rec["status"] == "ok"
+
+
+# ---------------------------------------------------------------------------
+# linearizability checker
+# ---------------------------------------------------------------------------
+
+def _op(op, value=None, out=None, inv=0, res=0, status="ok", key="k",
+        op_id=0):
+    return dict(op=op, key=key, value=value, out=out, inv=inv,
+                res=res, status=status, op_id=op_id)
+
+
+def test_checker_accepts_legal_histories():
+    assert check_key([
+        _op("put", value="v1", inv=0, res=1),
+        _op("get", out="v1", inv=2, res=3, op_id=1),
+    ])["ok"] is True
+    # concurrent writes: either order is a valid linearization
+    assert check_key([
+        _op("put", value="a", inv=0, res=5),
+        _op("put", value="b", inv=1, res=6, op_id=1),
+        _op("get", out="a", inv=7, res=8, op_id=2),
+    ])["ok"] is True
+    # rm -> absent read
+    assert check_key([
+        _op("put", value="v", inv=0, res=1),
+        _op("rm", inv=2, res=3, op_id=1),
+        _op("get", out=None, inv=4, res=5, op_id=2),
+    ])["ok"] is True
+
+
+def test_checker_rejects_stale_read():
+    r = check_key([
+        _op("put", value="v1", inv=0, res=1),
+        _op("put", value="v2", inv=2, res=3, op_id=1),
+        _op("get", out="v1", inv=4, res=5, op_id=2),
+    ])
+    assert r["ok"] is False
+    assert "linearizable_prefix" in r
+
+
+def test_checker_treats_timeouts_as_ambiguous():
+    # an unacked write may have applied...
+    ops = [
+        _op("put", value="v1", inv=0, res=1),
+        _op("put", value="v2", inv=2, res=None, status="timeout",
+            op_id=1),
+        _op("get", out="v2", inv=4, res=5, op_id=2),
+    ]
+    assert check_key(ops)["ok"] is True
+    # ...or not
+    ops[2]["out"] = "v1"
+    assert check_key(ops)["ok"] is True
+    # but a value nobody ever wrote is still a violation
+    ops[2]["out"] = "v9"
+    assert check_key(ops)["ok"] is False
+
+
+def test_checker_is_per_key_partitioned():
+    res = check_history([
+        _op("put", value="a", inv=0, res=1, key="x"),
+        _op("get", out="a", inv=2, res=3, key="x", op_id=1),
+        _op("put", value="b", inv=0, res=1, key="y", op_id=2),
+        _op("get", out="stale", inv=2, res=3, key="y", op_id=3),
+    ])
+    assert res["ok"] is False
+    assert res["violations"] == ["y"]
+    assert res["keys"]["x"]["ok"] is True
+
+
+# ---------------------------------------------------------------------------
+# invariants (shared with tests/test_fuzz.py)
+# ---------------------------------------------------------------------------
+
+def _res(R=3, **over):
+    base = dict(term=[1] * R, role=[int(Role.FOLLOWER)] * R,
+                head=[0] * R, apply=[0] * R, commit=[0] * R,
+                end=[0] * R)
+    base.update(over)
+    return base
+
+
+def test_invariant_checker_catches_each_class():
+    inv = InvariantChecker(3)
+    inv.check_step(_res(commit=[5, 0, 0], end=[5, 0, 0],
+                        apply=[5, 0, 0]))
+    with pytest.raises(InvariantViolation, match="I2"):
+        inv.check_step(_res(commit=[4, 0, 0], end=[4, 0, 0],
+                            apply=[4, 0, 0]))
+    inv2 = InvariantChecker(3)
+    inv2.check_step(_res(role=[int(Role.LEADER), 1, 1], term=[3, 3, 3]))
+    with pytest.raises(InvariantViolation, match="I4"):
+        inv2.check_step(_res(role=[1, int(Role.LEADER), 1],
+                             term=[3, 3, 3]))
+    with pytest.raises(InvariantViolation, match="I5"):
+        InvariantChecker(3).check_step(_res(commit=[1, 0, 0]))
+    with pytest.raises(InvariantViolation, match="I1/I3"):
+        InvariantChecker(2).check_convergence(
+            [[(1, 1, 1, b"a")], [(1, 1, 1, b"b")]])
+    InvariantChecker(2).check_convergence(
+        [[(1, 1, 1, b"a")], [(1, 1, 1, b"a"), (1, 1, 2, b"b")]])
+
+
+def test_invariant_checker_restart_rearms_commit_baseline():
+    inv = InvariantChecker(3)
+    inv.check_step(_res(commit=[9, 9, 9], end=[9, 9, 9],
+                        apply=[9, 9, 9]))
+    inv.reset_replica(0)                 # crash-restart incarnation
+    inv.check_step(_res(commit=[3, 9, 9], end=[3, 9, 9],
+                        apply=[3, 9, 9]))
+    # rebases keep absolute commit monotone
+    inv.check_step(_res(commit=[1, 7, 7], end=[1, 7, 7],
+                        apply=[1, 7, 7]), rebased_total=2)
+
+
+# ---------------------------------------------------------------------------
+# timers + artifact
+# ---------------------------------------------------------------------------
+
+def test_step_timer_model_skew_biases_firing():
+    idle = dict(hb_seen=[0, 0, 0], role=[int(Role.FOLLOWER)] * 3)
+    tm = StepTimerModel(3, seed=5, lo=8, hi=12)
+    tm.skew(0, 0.2)                      # trigger-happy replica 0
+    fired = []
+    for _ in range(60):
+        fired += tm.fire(set())
+        tm.observe(idle)
+    assert fired, "no timer ever fired"
+    assert fired.count(0) > fired.count(1)
+    assert fired.count(0) > fired.count(2)
+    # crashed replicas never fire
+    tm2 = StepTimerModel(3, seed=5, lo=2, hi=3)
+    for _ in range(10):
+        assert 1 not in tm2.fire({1})
+        tm2.observe(idle)
+
+
+def test_reproducer_artifact_round_trip(tmp_path):
+    sched = FaultSchedule().at(2, "crash", replica=1).at(
+        5, "restart", replica=1)
+    path = write_reproducer(
+        str(tmp_path / "repro.json"), seed=77, schedule=sched,
+        reason="unit", config={"n_replicas": 3},
+        history='{"t": 0, "ev": "invoke"}',
+        violation={"invariant": "I2"}, obs=Observability())
+    doc = load_reproducer(path)
+    assert doc["seed"] == 77 and doc["reason"] == "unit"
+    assert doc["schedule"] == sched.events
+    assert doc["violation"]["invariant"] == "I2"
+    assert "metrics" in doc and "trace" in doc
+
+
+# ---------------------------------------------------------------------------
+# protocol-level link faults + crash-restart semantics
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_asymmetric_link_fault_partial_acks():
+    """Replica 2 stops hearing the leader (one direction only): it
+    stops acking while replica 1 keeps the quorum alive — commit still
+    advances, and the leader sees exactly which peer went quiet."""
+    c = SimCluster(KVCFG, 3)
+    link = LinkModel(3, seed=0)
+    c.link_model = link
+    c.run_until_elected(0)
+    link.block(2, 0)                     # 2 cannot hear 0
+    c.submit(0, b"still-commits")
+    res = c.step()
+    res = c.step()
+    assert res["peer_acked"][0][1] == 1
+    assert res["peer_acked"][0][2] == 0
+    assert any(p == b"still-commits" for (_, _, _, p) in c.replayed[0])
+    link.heal()
+    for _ in range(3):
+        res = c.step()
+    assert res["peer_acked"][0][2] == 1  # catches back up after heal
+
+
+@pytest.mark.chaos
+def test_crash_wipes_uncommitted_suffix():
+    """An isolated leader's locally-appended (uncommitted) entries are
+    volatile: crash-restart recovers only the applied/stable prefix —
+    the suffix is gone, exactly what a real crash loses."""
+    c = SimCluster(KVCFG, 3)
+    link = LinkModel(3, seed=0)
+    c.link_model = link
+    c.run_until_elected(0)
+    base = c.step()
+    link.partition([[0], [1, 2]])
+    c.submit(0, b"orphan")
+    res = c.step()                       # appends locally, cannot commit
+    assert int(res["end"][0]) > int(res["commit"][0])
+    commit0 = int(res["commit"][0])
+    crash_replica(c, 0, link)
+    c.step()
+    restart_replica(c, 0, link)
+    link.heal()
+    res = c.step()
+    assert int(res["end"][0]) == commit0          # suffix wiped
+    assert int(res["role"][0]) == int(Role.FOLLOWER)
+    del base
+
+
+@pytest.mark.chaos
+def test_retransmit_dedup_survives_crash_restart():
+    """Satellite: the ``last_req`` registry claims dedup survives
+    reconnects and failover — prove it under crash-restart: the leader
+    commits a PUT and dies before acking; the client retransmits
+    (twice) against the new leader; the old leader restarts with wiped
+    volatile state and a registry rebuilt from its store. Every
+    replica — including the restarted one — applies the PUT exactly
+    once."""
+    c = SimCluster(KVCFG, 3)
+    link = LinkModel(3, seed=0)
+    c.link_model = link
+    kv = ReplicatedKVS(c, cap=256)
+    hard = HardStateTracker(3)
+    c.run_until_elected(0)
+    hard.observe(c.last)
+    sess = kv.session(client_id=7)
+    rid = sess.put(0, b"k", b"v1")
+    c.step()
+    c.step()
+    hard.observe(c.last)
+    assert kv.get(0, b"k", linearizable=True) == b"v1"   # committed...
+    crash_replica(c, 0, link)            # ...but the ack never left
+    res = None
+    for _ in range(4):
+        res = c.step(timeouts=[1])
+        hard.observe(res)
+        if res["role"][1] == int(Role.LEADER):
+            break
+    assert res["role"][1] == int(Role.LEADER)
+    # client retries against the new leader — twice, as a lossy network
+    # would
+    sess.retransmit_put(1, b"k", b"v1", rid)
+    sess.retransmit_put(1, b"k", b"v1", rid)
+    c.step()
+    c.step()
+    hard.observe(c.last)
+    restart_replica(c, 0, link, hard=hard, kvs=kv)
+    for _ in range(6):
+        hard.observe(c.step())
+    for r in range(3):
+        assert kv.get(r, b"k") == b"v1"
+    # the survivors deduped both duplicates; the restarted replica's
+    # registry was rebuilt from the committed stream and deduped
+    # identically (dedup derives from the log, not leader-local memory)
+    assert kv.deduped[1] == 2 and kv.deduped[2] == 2
+    assert kv.deduped[0] == 2
+    # election safety across the crash: nobody double-voted — a single
+    # leader per term throughout
+    assert int(c.last["term"][0]) == int(c.last["term"][1])
+
+
+# ---------------------------------------------------------------------------
+# nemesis runner
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_nemesis_smoke_clean_run():
+    """Tier-1 smoke: a short seeded schedule (partitions, crash-restart,
+    drops, delays, duplication, skew) over 3 replicas — invariants
+    hold and the recorded client history linearizes."""
+    obs = Observability()
+    runner = NemesisRunner(n_replicas=3, seed=7, steps=50, obs=obs)
+    v = runner.run()
+    assert v["ok"], v
+    assert v["invariant_violations"] == []
+    assert v["linearizability"]["ok"] is True
+    assert v["linearizability"]["ops"] > 10
+    assert len(runner.schedule) > 0
+    assert obs.metrics.get("faults_injected_total") > 0
+
+
+@pytest.mark.chaos
+def test_nemesis_seeded_run_is_bit_reproducible():
+    """Acceptance: same seed ⇒ same schedule, same history (JSONL
+    byte-identical — logical clocks only), same verdict."""
+    r1 = NemesisRunner(n_replicas=3, seed=13, steps=40)
+    v1 = r1.run()
+    r2 = NemesisRunner(n_replicas=3, seed=13, steps=40)
+    v2 = r2.run()
+    assert r1.schedule.to_json() == r2.schedule.to_json()
+    assert r1.history.to_jsonl() == r2.history.to_jsonl()
+    assert v1 == v2
+
+
+def _buggy_fold(self, r):
+    """The dedup bug under test: ``last_req`` is still tracked but the
+    skip is gone — a duplicated client message re-applies, so a stale
+    retransmit can roll a key back."""
+    stream = self.c.replayed[r]
+    while self._cursor[r] < len(stream):
+        etype, conn, req, payload = stream[self._cursor[r]]
+        self._cursor[r] += 1
+        if etype != int(EntryType.SEND):
+            continue
+        if len(payload) != CMD_W * 4:
+            continue
+        if req > 0 and conn > 0:
+            self.last_req[r][conn] = max(self.last_req[r].get(conn, 0),
+                                         req)
+        cmd = jnp.asarray(np.frombuffer(payload, "<i4"))
+        self.tables[r], _ = self._apply_jit(self.tables[r], cmd)
+
+
+_BUG_RUN = dict(n_replicas=3, steps=80, n_keys=2,
+                workload_opts=dict(dup_msg_p=0.9, dup_delay=6,
+                                   p_write=0.6),
+                fault_kinds=("partition", "crash"))
+
+
+@pytest.mark.chaos
+def test_injected_dedup_bug_is_caught_and_replayable(tmp_path,
+                                                     monkeypatch):
+    """Acceptance: break the exactly-once fold (test-only monkeypatch)
+    and the linearizability checker catches the client-visible anomaly
+    (a duplicated PUT rolling a key back), emitting a reproducer
+    artifact that replays to the same verdict. The identical run with
+    the real fold is clean — the checker flags the bug, not the
+    schedule."""
+    monkeypatch.setattr(ReplicatedKVS, "_fold", _buggy_fold)
+    art = str(tmp_path / "dedup_bug.json")
+    v = NemesisRunner(seed=1, artifact_path=art, **_BUG_RUN).run()
+    assert not v["ok"]
+    assert v["invariant_violations"] == []   # protocol is fine...
+    assert v["linearizability"]["ok"] is False   # ...the CONTRACT broke
+    assert v["artifact"] == art and os.path.exists(art)
+    doc = load_reproducer(art)
+    assert doc["seed"] == 1 and doc["history"]
+    assert doc["violation"]["linearizability"]["violations"]
+    # replay the artifact: deterministic harness, same verdict
+    v2 = NemesisRunner.replay(art)
+    assert v2["linearizability"]["violations"] == \
+        v["linearizability"]["violations"]
+    # control: the unbroken fold runs the SAME schedule clean
+    monkeypatch.undo()
+    v3 = NemesisRunner(seed=1, **_BUG_RUN).run()
+    assert v3["ok"], v3
+
+
+@pytest.mark.chaos
+def test_nemesis_refuses_psum_incompatible_schedule(caplog):
+    """Satellite: partitions cannot be modeled under fanout='psum'
+    (SimCluster raises mid-step by design) — the runner must refuse at
+    construction or skip with a clear log line, never die mid-run."""
+    sched = (FaultSchedule()
+             .at(2, "partition", groups=[[0], [1, 2]])
+             .at(6, "heal")
+             .at(10, "dup", p=0.5))
+    with pytest.raises(ValueError, match="psum"):
+        NemesisRunner(n_replicas=3, seed=0, steps=20, schedule=sched,
+                      fanout="psum")
+    with caplog.at_level(logging.WARNING, "rdma_paxos_tpu.chaos"):
+        runner = NemesisRunner(n_replicas=3, seed=0, steps=20,
+                               schedule=FaultSchedule(sched.events),
+                               fanout="psum",
+                               skip_incompatible_faults=True)
+    assert runner.schedule.mask_affecting() == []
+    assert len(runner.schedule) == 2          # heal + dup survive
+    assert any("skipping" in r.message for r in caplog.records)
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_nemesis_long_mixed_schedule_five_replicas():
+    """The full fault mix at R=5 over a long schedule — excluded from
+    tier-1 (slow) so its wall time never taxes the fast suite."""
+    for seed in (0, 1, 2):
+        v = NemesisRunner(n_replicas=5, seed=seed, steps=100).run()
+        assert v["ok"], (seed, v)
+
+
+# ---------------------------------------------------------------------------
+# jit-safety: the link model + chaos instrumentation are host-side only
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_compiled_step_cache_keys_unchanged_by_chaos():
+    """Acceptance guard (same style as tests/test_obs.py): attaching a
+    link model, history recorder, and chaos observability must not add
+    or change any compiled-step cache key — faults are INPUT DATA, not
+    program structure."""
+    bare = SimCluster(KVCFG, 3)
+    bare.run_until_elected(0)
+    bare.submit(0, b"x")
+    bare.step()
+    keys_before = set(SimCluster._STEP_CACHE)
+
+    v = NemesisRunner(n_replicas=3, seed=3, steps=25).run()
+    assert v["ok"], v
+    assert set(SimCluster._STEP_CACHE) == keys_before, (
+        "chaos changed the compiled-step cache keys — the link model "
+        "or instrumentation leaked into jitted code")

@@ -22,6 +22,17 @@ from rdma_paxos_tpu.consensus.membership import MembershipManager
 from rdma_paxos_tpu.consensus.snapshot import export_row, genesis_row
 from rdma_paxos_tpu.consensus.state import ConfigState, Role
 from rdma_paxos_tpu.runtime.sim import SimCluster
+from tests.conftest import jax_multiprocess_cpu
+
+# the full elastic worlds run one NodeDaemon OS process per host over
+# jax.distributed — impossible on a jaxlib whose CPU backend lacks
+# cross-process collectives (the workers die at boot and the
+# supervisors churn generations until the assertion timeout)
+needs_multiprocess_cpu = pytest.mark.skipif(
+    not jax_multiprocess_cpu(),
+    reason="cross-process CPU collectives unavailable (jaxlib raises "
+           "'Multiprocess computations aren't implemented on the CPU "
+           "backend'); needs jax >= 0.5")
 
 NATIVE = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "native")
@@ -309,6 +320,7 @@ def built_native():
                    capture_output=True)
 
 
+@needs_multiprocess_cpu
 def test_elastic_loss_restart_rejoin(tmp_path, built_native):
     from rdma_paxos_tpu.runtime.elastic import (ElasticSupervisor,
                                                 GroupController)
@@ -391,6 +403,7 @@ def test_elastic_loss_restart_rejoin(tmp_path, built_native):
         ctl.close()
 
 
+@needs_multiprocess_cpu
 def test_leader_sigkill_under_speculative_load(tmp_path, built_native):
     """The reference's RemoveLeader scenario (reconf_bench.sh:96-123) at
     FULL stack depth with speculative clients in flight: SIGKILL the

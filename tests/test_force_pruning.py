@@ -195,9 +195,13 @@ def test_auto_recovery_live_app_exactly_once(tmp_path):
                    capture_output=True)
     base = 9950 + (os.getpid() % 40)
     ports = [base, base + 40, base + 80]
+    # wide election timeouts: the drill needs NO mid-test election, and
+    # on a slow/loaded host a single driver iteration can exceed a
+    # sub-second timeout — the spurious deposition severs the client
+    # session mid-drill (empty readline) for a pure environment reason
     d = ClusterDriver(CFG_APP, 3, workdir=str(tmp_path), app_ports=ports,
-                      timeout_cfg=TimeoutConfig(elec_timeout_low=0.3,
-                                                elec_timeout_high=0.6))
+                      timeout_cfg=TimeoutConfig(elec_timeout_low=2.0,
+                                                elec_timeout_high=4.0))
     apps = []
     try:
         for r, port in enumerate(ports):

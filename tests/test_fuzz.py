@@ -3,7 +3,10 @@
 The reference has no race detection or fault injection (SURVEY.md §5);
 its safety rests on design comments. Here, every step of a seeded random
 schedule (random partitions, heals, election timeouts, client
-submissions) checks the core safety invariants of the protocol:
+submissions) checks the core safety invariants of the protocol — the
+I1–I5 definitions live in ``rdma_paxos_tpu.chaos.invariants`` (shared
+with the nemesis runner, so the fuzzer and the chaos harness can never
+drift apart):
 
   I1 (committed-prefix agreement): all replicas agree on entries below
       their commit indices — byte-for-byte identical replay streams.
@@ -13,13 +16,20 @@ submissions) checks the core safety invariants of the protocol:
   I4 (single leader per term): two replicas never claim leadership in
       the same term.
   I5 (invariant chain): head <= apply <= commit <= end on every replica.
+
+On any violation the fuzzer dumps a reproducer artifact (seed, the
+recorded action schedule, the obs trace ring, metrics) and puts its
+path in the assertion message — a failing CI line is replayable, not
+just a (seed, step, replica) tuple.
 """
 
 import random
 
-import numpy as np
 import pytest
 
+from rdma_paxos_tpu.chaos.artifact import load_reproducer, write_reproducer
+from rdma_paxos_tpu.chaos.invariants import (
+    InvariantChecker, InvariantViolation)
 from rdma_paxos_tpu.config import LogConfig
 from rdma_paxos_tpu.consensus.state import Role
 from rdma_paxos_tpu.runtime.sim import SimCluster
@@ -48,52 +58,102 @@ def test_random_schedule_max_group_sizes(R):
     _fuzz_schedule(100 + R, R)
 
 
+def _dump(seed, R, schedule, exc: InvariantViolation) -> str:
+    """Reproducer artifact for a failed fuzz run: the recorded action
+    schedule (evidence) + trace ring + metrics. A fuzz run is fully
+    determined by ``(seed, R)``, so the artifact replays with
+    :func:`replay_fuzz_artifact` (NOT ``NemesisRunner.replay`` — the
+    recorded ``op="step"`` actions are the fuzzer's own vocabulary,
+    not FaultSchedule ops)."""
+    return write_reproducer(
+        seed=seed, schedule=schedule,
+        reason=f"fuzz invariant violation: {exc.invariant}",
+        config=dict(harness="fuzz", seed=seed, n_replicas=R,
+                    log=dict(n_slots=CFG.n_slots,
+                             slot_bytes=CFG.slot_bytes,
+                             window_slots=CFG.window_slots,
+                             batch_slots=CFG.batch_slots)),
+        violation=exc.as_dict())
+
+
+def replay_fuzz_artifact(path: str) -> None:
+    """Re-run the failing fuzz schedule from a reproducer artifact.
+    The run is deterministic in (seed, n_replicas), so this reproduces
+    the identical schedule and re-raises the identical violation."""
+    doc = load_reproducer(path)
+    _fuzz_schedule(doc["config"]["seed"], doc["config"]["n_replicas"])
+
+
+def test_fuzz_reproducer_artifact_replays(monkeypatch, tmp_path):
+    """The artifact a failing fuzz run dumps must actually replay: it
+    carries (seed, n_replicas) and replay_fuzz_artifact re-enters the
+    deterministic schedule with exactly those parameters."""
+    import os
+    import tests.test_fuzz as tf
+    exc = InvariantViolation("I5", "synthetic", replica=0, step=3)
+    path = _dump(4, 3, [dict(step=0, op="heal")], exc)
+    try:
+        calls = []
+        monkeypatch.setattr(tf, "_fuzz_schedule",
+                            lambda s, r: calls.append((s, r)))
+        replay_fuzz_artifact(path)
+        assert calls == [(4, 3)]
+    finally:
+        os.unlink(path)
+
+
 def _fuzz_schedule(seed, R):
     rng = random.Random(seed)
     c = SimCluster(CFG, R)
-    prev_commit = np.zeros(R, np.int64)
-    seen_terms = {}          # term -> leader id (I4)
-    durable = {}             # index -> payload bytes (I3 witness)
+    inv = InvariantChecker(R)
     payload_n = 0
+    schedule = []       # recorded actions -> the reproducer artifact
 
     for step_i in range(120):
         action = rng.random()
         if action < 0.15:
-            c.partition(random_partition(rng, R))
+            groups = random_partition(rng, R)
+            schedule.append(dict(step=step_i, op="partition",
+                                 groups=groups))
+            c.partition(groups)
         elif action < 0.30:
+            schedule.append(dict(step=step_i, op="heal"))
             c.heal()
         timeouts = [r for r in range(R) if rng.random() < 0.08]
+        submitted = []
         for r in range(R):
             if rng.random() < 0.5:
                 payload_n += 1
                 c.submit(r, b"p%05d" % payload_n)
+                submitted.append(r)
+        if timeouts or submitted:
+            schedule.append(dict(step=step_i, op="step",
+                                 timeouts=timeouts,
+                                 submitted=submitted))
         res = c.step(timeouts=timeouts)
 
-        # I2: commit monotone
-        for r in range(R):
-            assert res["commit"][r] >= prev_commit[r], (seed, step_i, r)
-            prev_commit[r] = res["commit"][r]
-        # I4: single leader per term
-        for r in range(R):
-            if res["role"][r] == int(Role.LEADER):
-                t = int(res["term"][r])
-                assert seen_terms.setdefault(t, r) == r, (seed, step_i, t)
-        # I5: offset chain
-        for r in range(R):
-            assert (res["head"][r] <= res["apply"][r]
-                    <= res["commit"][r] <= res["end"][r]), (seed, step_i, r)
+        # I2 + I4 + I5, shared implementation (chaos.invariants)
+        try:
+            inv.check_step(res, step=step_i,
+                           rebased_total=c.rebased_total)
+        except InvariantViolation as exc:
+            raise AssertionError(
+                f"{exc} [seed={seed} R={R}; reproducer: "
+                f"{_dump(seed, R, schedule, exc)}]") from exc
 
     c.heal()
+    schedule.append(dict(step=120, op="heal"))
     for _ in range(6):
         res = c.step()
 
     # I1 + I3: all replicas' replay streams agree on the common prefix,
     # and every stream is a prefix of the longest one
-    streams = [[(t, conn, req, p) for (t, conn, req, p) in c.replayed[r]]
-               for r in range(R)]
-    longest = max(streams, key=len)
-    for r, s in enumerate(streams):
-        assert s == longest[:len(s)], (seed, r)
+    try:
+        inv.check_convergence(c.replayed)
+    except InvariantViolation as exc:
+        raise AssertionError(
+            f"{exc} [seed={seed} R={R}; reproducer: "
+            f"{_dump(seed, R, schedule, exc)}]") from exc
 
     # liveness smoke: after healing, the cluster still elects and commits
     # (rotating candidacies, as a real driver's randomized timers would —

@@ -9,6 +9,14 @@ import sys
 
 import pytest
 
+from tests.conftest import jax_multiprocess_cpu
+
+pytestmark = pytest.mark.skipif(
+    not jax_multiprocess_cpu(),
+    reason="cross-process CPU collectives unavailable (jaxlib raises "
+           "'Multiprocess computations aren't implemented on the CPU "
+           "backend'); needs jax >= 0.5")
+
 WORKER = r"""
 import os, sys
 pid, n, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]

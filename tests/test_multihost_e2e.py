@@ -13,6 +13,14 @@ import time
 
 import pytest
 
+from tests.conftest import jax_multiprocess_cpu
+
+pytestmark = pytest.mark.skipif(
+    not jax_multiprocess_cpu(),
+    reason="cross-process CPU collectives unavailable (jaxlib raises "
+           "'Multiprocess computations aren't implemented on the CPU "
+           "backend'); needs jax >= 0.5")
+
 NATIVE = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "native")
 _BASE = 7800 + (os.getpid() % 400)
