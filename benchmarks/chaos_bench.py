@@ -88,6 +88,9 @@ def main():
                      row["checker_states"],
                      ("  artifact=" + row["artifact"])
                      if row["artifact"] else ""))
+        from benchmarks.reporting import emit
+        emit("chaos_steps_per_sec", row["steps_per_s"], "steps/s",
+             detail=row, obs=runner.obs)
         if not verdict["ok"]:
             failures += 1
     sys.exit(1 if failures else 0)

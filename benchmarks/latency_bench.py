@@ -314,6 +314,18 @@ def main():
     if args.json:
         with open(args.json, "w") as f:
             json.dump(out, f, indent=2)
+    # the standardized BENCH line (benchmarks.reporting): headline =
+    # batch-1 dispatch p99 vs the 50 µs target; bulky per-row registry
+    # snapshots stay in the artifact doc only
+    from benchmarks.reporting import emit
+    emit("commit_latency_frontier",
+         rows[0]["dispatch"]["p99_us"], "us",
+         detail=dict(
+             backend=backend, target_p99_us=50.0,
+             bare_p99_us=bare["p99_us"],
+             batch1_vs_bare_p99=out["batch1_vs_bare_p99"],
+             rows=[{k: v for k, v in r.items() if k != "metrics"}
+                   for r in rows]))
 
 
 if __name__ == "__main__":

@@ -182,6 +182,11 @@ def main():
     if args.json:
         with open(args.json, "w") as f:
             json.dump(out, f, indent=2)
+    from benchmarks.reporting import emit
+    emit("loggp_step_parameters",
+         out["rows"]["psum"]["o_plus_L_us"], "us",
+         detail=dict(backend=out["backend"], rows=out["rows"],
+                     samples_per_row=out["samples_per_row"]))
 
 
 if __name__ == "__main__":

@@ -124,6 +124,9 @@ def main():
     if args.json:
         with open(args.json, "w") as f:
             json.dump(out, f, indent=2)
+    from benchmarks.reporting import emit
+    emit("per_replica_step_cost_vs_R", rows[0]["per_replica_us"], "us",
+         detail=dict(topology=out["topology"], rows=rows))
 
 
 if __name__ == "__main__":

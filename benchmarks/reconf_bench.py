@@ -146,6 +146,13 @@ def main():
     if args.json:
         with open(args.json, "w") as f:
             json.dump(out, f, indent=2)
+    from benchmarks.reporting import emit
+    emit("reconfiguration_timings",
+         out["scenarios"].get("remove_leader_new_leader_ms"), "ms",
+         detail=dict(backend=out["backend"],
+                     scenarios=out["scenarios"],
+                     config=out["config"]),
+         obs=d.obs)
 
 
 if __name__ == "__main__":

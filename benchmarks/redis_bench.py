@@ -199,9 +199,17 @@ def main():
     out = bench.stdout.decode()
     print("\n".join(l for l in out.splitlines()
                     if "requests per second" in l or "SET" in l))
+    rps = None
+    for l in out.splitlines():
+        if "requests per second" in l:
+            try:
+                rps = float(l.split()[0].strip('"'))
+            except ValueError:
+                pass
 
     # follower state equality, the run.sh FindLeader+verify analog
     time.sleep(2.0)
+    followers_equal = True
     lead_size = resp(ports[lead], b"DBSIZE")
     for r in range(args.replicas):
         if r == lead:
@@ -213,11 +221,19 @@ def main():
             if size == lead_size:
                 break
             time.sleep(0.5)
+        followers_equal = followers_equal and size == lead_size
         print(f"replica {r} DBSIZE {size.decode()} "
               f"(leader {lead_size.decode()})"
               + ("  OK" if size == lead_size else "  MISMATCH"))
 
     driver.stop()
+    from benchmarks.reporting import emit
+    emit("redis_set_ops_per_sec", rps, "ops/s",
+         detail=dict(replicas=args.replicas, n=args.n, c=args.c,
+                     P=args.P, r=args.r, fanout=args.fanout,
+                     followers_equal=followers_equal,
+                     leader_dbsize=int(lead_size.lstrip(b":") or 0)),
+         obs=driver.obs)
     if stats is not None:
         lw = (stats["loop_wall"][1] - stats["loop_wall"][0]
               if stats["loop_wall"][0] is not None else 0.0)

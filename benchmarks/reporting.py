@@ -1,0 +1,62 @@
+"""Shared benchmark result emitter — ONE schema for every benchmark.
+
+Before this module each benchmark invented its own output: only
+``run_bench.py`` and ``latency_bench.py`` exported obs registry
+snapshots, while ``r_scaling``/``reconf_bench``/``loggp``/
+``chaos_bench``/``redis_bench`` printed ad-hoc text or bespoke JSON
+docs — which is why the BENCH trajectory could not track them. Every
+benchmark now routes its headline result through :func:`emit`, which
+produces:
+
+* a greppable ``BENCH:{...}`` stdout line — ``metric``/``value``/
+  ``unit``/``detail`` (the BENCH_* round schema), WITHOUT the bulky
+  snapshot, so logs stay readable;
+* optionally, one full JSON line appended to ``json_path`` carrying
+  the same fields PLUS the obs metrics registry snapshot and the
+  shared ``(monotonic, wall)`` clock anchor (obs.clock) — so bench
+  rows align on the same timebase as trace/health/span dumps.
+
+Benchmarks keep their existing human-readable prints and artifact
+files; the emitter is the machine-readable common denominator.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+
+def emit(metric: str, value=None, unit: Optional[str] = None, *,
+         detail: Optional[dict] = None, obs=None, registry=None,
+         json_path: Optional[str] = None, stdout: bool = True) -> dict:
+    """Build, print, and optionally append the standardized result row.
+
+    ``obs`` (an Observability facade) or ``registry`` (a bare
+    MetricsRegistry) supplies the snapshot; with neither, the
+    process-global default registry is used (subprocess-fanout benches
+    record little there — the snapshot is still stamped for schema
+    uniformity). The snapshot is taken only when it will actually be
+    persisted (``json_path`` set) — the stdout line never carries it.
+    Returns the full row dict."""
+    from rdma_paxos_tpu.obs.clock import anchor
+    row = dict(schema=1, metric=metric, anchor=anchor())
+    if value is not None:
+        row["value"] = value
+    if unit is not None:
+        row["unit"] = unit
+    if detail:
+        row["detail"] = detail
+    line = {k: v for k, v in row.items() if k != "anchor"}
+    if stdout:
+        print("BENCH:" + json.dumps(line))
+    if json_path:
+        if registry is None:
+            if obs is not None:
+                registry = obs.metrics
+            else:
+                from rdma_paxos_tpu.obs.metrics import default_registry
+                registry = default_registry()
+        row["metrics"] = registry.snapshot()
+        with open(json_path, "a") as f:
+            f.write(json.dumps(row) + "\n")
+    return row

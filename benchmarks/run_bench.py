@@ -83,6 +83,19 @@ def main():
     ap.add_argument("--metrics-json", default=None,
                     help="write the full obs metrics snapshot here "
                          "(default: <workdir>/metrics.json)")
+    ap.add_argument("--trace", action="store_true",
+                    help="causal tracing at 100%% sampling: every "
+                         "command gets an end-to-end span; writes the "
+                         "raw span dump and a Perfetto-loadable Chrome "
+                         "trace next to the metrics snapshot")
+    ap.add_argument("--trace-json", default=None,
+                    help="Chrome trace output path (default: "
+                         "<workdir>/trace.perfetto.json)")
+    ap.add_argument("--fence", action="store_true",
+                    help="fence each device step with block_until_ready "
+                         "so step-phase histograms attribute device-sync "
+                         "time separately from dispatch (profiling mode; "
+                         "serializes the dispatch pipeline)")
     args = ap.parse_args()
 
     os.environ.setdefault(
@@ -107,7 +120,12 @@ def main():
         cfg, args.replicas, workdir=wd, app_ports=ports,
         timeout_cfg=TimeoutConfig(elec_timeout_low=0.5,
                                   elec_timeout_high=1.0),
-        fanout="psum")
+        fanout="psum", fence=args.fence)
+    if args.trace:
+        # 100% sampling (the default is rate-limited); capacity sized
+        # so a full run's spans are retained for the export
+        driver.obs.spans.resize(max(args.requests * 2, 4096))
+        driver.obs.spans.set_sample_every(1)
     print("prewarming step/burst compiles...")
     driver.prewarm()
     apps = []
@@ -177,22 +195,62 @@ def main():
           f"{len(metrics_snap['histograms'])} histograms)")
     print("METRICS:" + json.dumps(metrics_snap))
     print("HEALTH:" + json.dumps(health))
-    if args.json:
-        with open(args.json, "a") as jf:
-            jf.write(json.dumps(dict(
-                metric="e2e_committed_ops_per_sec",
-                value=round(n / dt, 1),
-                requests=n, seconds=round(dt, 3),
-                clients=args.clients, pipeline=args.pipeline,
-                threaded_app=bool(args.threaded_app),
-                p50_ms=(round(lat[nb // 2] * 1e3, 2) if nb else None),
-                p95_ms=(round(lat[int(nb * .95)] * 1e3, 2)
-                        if nb else None),
-                p99_ms=(round(lat[int(nb * .99)] * 1e3, 2)
-                        if nb else None),
-                metrics=metrics_snap,
-                health=health,
-            )) + "\n")
+
+    trace_detail = None
+    if args.trace:
+        # let the followers' commit/apply frontiers catch up so every
+        # span carries all R replicas' marks before the export
+        time.sleep(0.5)
+        from rdma_paxos_tpu.obs import spans as spans_mod
+        # ONE dump feeds both artifacts + the stats, so the on-disk
+        # spans.json and the Perfetto trace can never disagree
+        raw = driver.obs.spans.dump()
+        spans_path = os.path.join(wd, "spans.json")
+        with open(spans_path, "w") as sf:
+            json.dump(raw, sf, indent=2)
+        trace_path = (args.trace_json
+                      or os.path.join(wd, "trace.perfetto.json"))
+        with open(trace_path, "w") as tf:
+            json.dump(spans_mod.to_chrome_trace(
+                raw, max_cp_tracks=4096), tf)
+        done = [s for s in raw["spans"] if s["status"] == "done"]
+        corr = [s for s in done
+                if s["term"] is not None
+                and len({r for p, r, _ in s["events"]
+                         if p == "commit"}) >= args.replicas]
+        # denominator: every event the proxy layer SUBMITTED (counted
+        # at intake; a few may have failed rather than committed)
+        submitted = sum(
+            v for k, v in metrics_snap["counters"].items()
+            if k.startswith("proxy_events_total"))
+        cover = len(done) / max(submitted, 1)
+        trace_detail = dict(
+            spans=len(raw["spans"]), completed=len(done),
+            correlated_all_replicas=len(corr),
+            submitted_events=submitted,
+            coverage=round(cover, 4), dropped=raw["dropped"],
+            spans_json=spans_path, perfetto_json=trace_path)
+        print(f"spans: {len(raw['spans'])} sampled, {len(done)} "
+              f"completed, {len(corr)} correlated across all "
+              f"{args.replicas} replicas ({cover:.1%} of {submitted} "
+              f"submitted events) -> {trace_path} (load in "
+              f"https://ui.perfetto.dev)")
+        print(spans_mod.format_breakdown(spans_mod.breakdown(raw)))
+
+    from benchmarks.reporting import emit
+    emit("e2e_committed_ops_per_sec", round(n / dt, 1), "ops/s",
+         detail=dict(
+             requests=n, seconds=round(dt, 3),
+             clients=args.clients, pipeline=args.pipeline,
+             threaded_app=bool(args.threaded_app),
+             p50_ms=(round(lat[nb // 2] * 1e3, 2) if nb else None),
+             p95_ms=(round(lat[int(nb * .95)] * 1e3, 2)
+                     if nb else None),
+             p99_ms=(round(lat[int(nb * .99)] * 1e3, 2)
+                     if nb else None),
+             fence=bool(args.fence), trace=trace_detail,
+             health=health),
+         obs=driver.obs, json_path=args.json)
 
     # replication check on one follower
     fol = next(r for r in range(args.replicas) if r != lead)

@@ -11,6 +11,11 @@ post-mortem evidence an operator wants:
   ran);
 * ``trace`` — the obs trace ring dump (protocol-event post-mortem);
 * ``metrics`` — the metrics registry snapshot;
+* ``spans`` — the causal span dump (obs.spans): every traced
+  command's submit→append→quorum→commit→apply→ack timeline with its
+  ``(term, index)`` correlation — feed it to
+  ``python -m rdma_paxos_tpu.obs.spans`` for a Perfetto view of the
+  violation;
 * ``violation`` / ``reason`` — what failed.
 
 Written atomically (tmp + rename, same discipline as
@@ -59,6 +64,8 @@ def write_reproducer(path: Optional[str] = None, *, seed: int,
         violation=violation,
         trace=obs.trace.dump(),
         metrics=obs.metrics.snapshot(),
+        spans=(obs.spans.dump()
+               if getattr(obs, "spans", None) is not None else None),
         extra=extra or {},
     )
     if path is None:

@@ -104,11 +104,15 @@ class _Workload:
             return
         self.kv._fold(leader)
         marks = self.kv.last_req[leader]
+        spans = self.kv._spans()
         for ci, out in enumerate(self.outstanding):
             if out is None:
                 continue
             if marks.get(out["client"], 0) >= out["req_id"]:
                 self.h.ok(out["op_id"])
+                if spans is not None:
+                    # the client observed its commit: the span's ack
+                    spans.ack_key(out["client"], out["req_id"])
                 self.outstanding[ci] = None
 
     # ---- issue phase (before the step) ----
@@ -142,6 +146,10 @@ class _Workload:
                 if t - out["issued"] > self.patience:
                     # fate unknown — ambiguous for the checker
                     self.h.timeout(out["op_id"])
+                    spans = self.kv._spans()
+                    if spans is not None:
+                        spans.fail_key(out["client"], out["req_id"],
+                                       status="timeout")
                     self.outstanding[ci] = None
                 elif leader >= 0 and leader != out["to"]:
                     # failover: retransmit the SAME req_id elsewhere
@@ -210,6 +218,13 @@ class NemesisRunner:
         self.artifact_path = artifact_path
         self.workload_opts = dict(workload_opts or {})
         self.obs = obs if obs is not None else Observability()
+        # chaos runs are short and their whole point is post-mortem
+        # evidence: trace EVERY command so a violation artifact ships
+        # the complete causal timeline — but only on a runner-OWNED
+        # facade; a caller-supplied (possibly shared, possibly live-
+        # production) facade keeps its configured sampling rate
+        if obs is None:
+            self.obs.spans.set_sample_every(1)
         if schedule is None:
             schedule = generate_schedule(seed, self.R, steps,
                                          kinds=fault_kinds)

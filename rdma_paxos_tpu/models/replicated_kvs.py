@@ -53,6 +53,14 @@ class ReplicatedKVS:
         # the linearizability checker. Host-side bookkeeping only.
         self.history = None
 
+    def _spans(self):
+        """The cluster's span recorder when causal tracing is on —
+        session mutations are span births keyed (client_id, req_id),
+        the same stamp that rides the entry's M_CONN/M_REQID columns
+        (so the sim's append hook correlates them with (term, index))."""
+        from rdma_paxos_tpu.obs.spans import active_recorder
+        return active_recorder(getattr(self.c, "obs", None))
+
     # ------------------------------------------------------------------
 
     def rebuild(self, r: int) -> None:
@@ -167,6 +175,10 @@ class ClientSession:
             self.kvs.history.invoke("put", key, val,
                                     client=self.client_id,
                                     req_id=self.req_id, replica=leader)
+        spans = self.kvs._spans()
+        if spans is not None:
+            spans.begin(self.client_id, self.req_id, leader,
+                        phase="submit")
         self.kvs.put(leader, key, val, client_id=self.client_id,
                      req_id=self.req_id)
         return self.req_id
@@ -176,6 +188,10 @@ class ClientSession:
         if self.kvs.history is not None:
             self.kvs.history.invoke("rm", key, client=self.client_id,
                                     req_id=self.req_id, replica=leader)
+        spans = self.kvs._spans()
+        if spans is not None:
+            spans.begin(self.client_id, self.req_id, leader,
+                        phase="submit")
         self.kvs.remove(leader, key, client_id=self.client_id,
                         req_id=self.req_id)
         return self.req_id
@@ -188,5 +204,10 @@ class ClientSession:
             op_id = self.kvs.history.op_id_for(self.client_id, req_id)
             if op_id is not None:
                 self.kvs.history.retransmit(op_id, replica=leader)
+        spans = self.kvs._spans()
+        if spans is not None:
+            # same (client, req) key -> same span: a retransmit is the
+            # same logical command, recorded as a retransmit mark
+            spans.begin(self.client_id, req_id, leader, phase="submit")
         self.kvs.put(leader, key, val, client_id=self.client_id,
                      req_id=req_id)

@@ -14,6 +14,13 @@ Three parts, all host-side, all zero-dependency (stdlib only):
   snapshot files (role, term, indices, log headroom vs the i32 rebase
   ceiling, inflight waiters, store progress), aggregated live by
   ``ClusterDriver.health()``.
+* :mod:`~rdma_paxos_tpu.obs.spans` — causal command tracing: sampled
+  end-to-end spans (submit → append ``(term, index)`` → quorum →
+  commit → apply → ack) with cross-replica correlation, a step-phase
+  profiler, and a Perfetto-loadable Chrome trace exporter.
+* :mod:`~rdma_paxos_tpu.obs.clock` — the shared ``(monotonic, wall)``
+  anchor pair every dump is stamped with, so trace/health/span
+  exports from different processes align on one timebase.
 
 HARD RULE: no metrics/trace call may execute inside a
 jitted/``shard_map``ped function — instrumentation lives in the host
@@ -26,34 +33,43 @@ from __future__ import annotations
 
 from typing import Optional
 
-from rdma_paxos_tpu.obs import health, metrics, trace
+from rdma_paxos_tpu.obs import clock, health, metrics, spans, trace
 from rdma_paxos_tpu.obs.health import HealthReporter
 from rdma_paxos_tpu.obs.metrics import MetricsRegistry
+from rdma_paxos_tpu.obs.spans import SpanRecorder, StepPhaseProfiler
 from rdma_paxos_tpu.obs.trace import TraceRing
 
 
 class Observability:
-    """Facade bundling one registry + one trace ring — the unit the
-    drivers thread through every layer. Each :class:`ClusterDriver`
-    gets its own (isolated, test-friendly); module-level code with no
-    driver in scope records against :func:`default`."""
+    """Facade bundling one registry + one trace ring + one span
+    recorder — the unit the drivers thread through every layer. Each
+    :class:`ClusterDriver` gets its own (isolated, test-friendly);
+    module-level code with no driver in scope records against
+    :func:`default`."""
 
     def __init__(self, metrics_registry: Optional[MetricsRegistry] = None,
-                 trace_ring: Optional[TraceRing] = None):
+                 trace_ring: Optional[TraceRing] = None,
+                 span_recorder: Optional[SpanRecorder] = None):
         self.metrics = (metrics_registry if metrics_registry is not None
                         else MetricsRegistry())
         self.trace = (trace_ring if trace_ring is not None
                       else TraceRing())
+        self.spans = (span_recorder if span_recorder is not None
+                      else SpanRecorder())
 
     def snapshot(self) -> dict:
         """Combined point-in-time export: the metrics snapshot plus the
-        trace ring's retained events."""
-        return {"metrics": self.metrics.snapshot(),
-                "trace": self.trace.dump()}
+        trace ring's retained events plus the span dump — every part
+        stamped with the shared clock anchor."""
+        return {"anchor": clock.anchor(),
+                "metrics": self.metrics.snapshot(),
+                "trace": self.trace.dump(),
+                "spans": self.spans.dump()}
 
     def reset(self) -> None:
         self.metrics.reset()
         self.trace.clear()
+        self.spans.reset()
 
 
 _default: Optional[Observability] = None
@@ -70,4 +86,5 @@ def default() -> Observability:
 
 
 __all__ = ["Observability", "MetricsRegistry", "TraceRing",
-           "HealthReporter", "default", "metrics", "trace", "health"]
+           "HealthReporter", "SpanRecorder", "StepPhaseProfiler",
+           "default", "metrics", "trace", "health", "spans", "clock"]

@@ -37,8 +37,14 @@ def validate(snap: dict) -> List[str]:
 
 
 def make_snapshot(**fields) -> dict:
-    """Stamp ``fields`` into a schema-versioned snapshot dict."""
-    snap = dict(schema=1, ts=time.time())
+    """Stamp ``fields`` into a schema-versioned snapshot dict. Carries
+    both clocks — ``ts`` (wall, operator-meaningful) and
+    ``ts_monotonic`` (ordering-safe) — plus the process's shared
+    ``(monotonic, wall)`` anchor pair (obs.clock), so health files
+    align on the same timebase as trace-ring and span dumps."""
+    from rdma_paxos_tpu.obs.clock import anchor
+    snap = dict(schema=1, ts=time.time(), ts_monotonic=time.monotonic(),
+                anchor=anchor())
     snap.update(fields)
     return snap
 

@@ -108,8 +108,13 @@ class TraceRing:
 
     def dump_json(self, reason: Optional[str] = None,
                   indent: Optional[int] = None) -> str:
+        # every event ts is time.monotonic(); the stamped anchor pair
+        # (obs.clock) lets readers project them onto the shared wall
+        # timebase and align this dump with health/span exports
+        from rdma_paxos_tpu.obs.clock import anchor
         return json.dumps(dict(reason=reason, capacity=self.capacity,
-                               events=self.dump()), indent=indent)
+                               anchor=anchor(), events=self.dump()),
+                          indent=indent)
 
     def dump_on_failure(self, path: str, reason: str) -> str:
         """Persist the ring (atomic tmp + rename) for post-mortem —

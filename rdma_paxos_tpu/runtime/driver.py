@@ -36,6 +36,7 @@ from rdma_paxos_tpu.consensus.state import ConfigState, Role
 from rdma_paxos_tpu.obs import Observability, trace as obs_trace
 from rdma_paxos_tpu.obs.health import HealthReporter, make_snapshot
 from rdma_paxos_tpu.obs.metrics import BATCH_BUCKETS, LATENCY_BUCKETS_S
+from rdma_paxos_tpu.obs.spans import StepPhaseProfiler
 from rdma_paxos_tpu.proxy.proxy import (
     PendingEvent, ProxyServer, ReplayEngine, spec_send_refused_dirty)
 from rdma_paxos_tpu.proxy.stablestore import (
@@ -105,17 +106,25 @@ class ClusterDriver:
                  sync_period: float = 0.05, step_down_steps: int = 50,
                  app_snapshot=None, fanout: str = "gather",
                  obs: Optional[Observability] = None,
-                 health_period: float = 0.5, link_model=None):
+                 health_period: float = 0.5, link_model=None,
+                 fence: bool = False):
         self.cfg = cfg
         self.sync_period = sync_period
         self._workdir = workdir
-        # observability: one registry + trace ring per driver (isolated
-        # by default — pass a shared facade to aggregate across
-        # drivers). ALL instrumentation is host-side: nothing below may
-        # run inside jitted code, and tests verify compiled-step cache
-        # keys are unchanged by it.
+        # observability: one registry + trace ring + span recorder per
+        # driver (isolated by default — pass a shared facade to
+        # aggregate across drivers). ALL instrumentation is host-side:
+        # nothing below may run inside jitted code, and tests verify
+        # compiled-step cache keys are unchanged by it.
         self.obs = obs if obs is not None else Observability()
         self._timer_obs = StepTimer(metrics=self.obs.metrics)
+        # step-phase wall-time attribution (obs.spans profiler). fence
+        # keeps its default (False) in production: fencing blocks on
+        # the step's outputs right after dispatch so device time lands
+        # in its own device_sync histogram — a profiling mode that
+        # serializes the dispatch pipeline, never the serving default.
+        self._phase_prof = StepPhaseProfiler(metrics=self.obs.metrics,
+                                             fence=fence)
         self._health = (HealthReporter(workdir, period=health_period)
                         if workdir else None)
         # bounded recovery: optional app-level snapshot hook tuple
@@ -153,6 +162,7 @@ class ClusterDriver:
         self.cluster = SimCluster(cfg, n_replicas, group_size, mode=mode,
                                   fanout=fanout)
         self.cluster.obs = self.obs
+        self.cluster.profiler = self._phase_prof
         # chaos hook: a per-link fault model (chaos.faults.LinkModel)
         # driven from outside the poll loop — fault-injection drills
         # against a LIVE driver (apps + stores + poll thread), not just
@@ -312,6 +322,9 @@ class ClusterDriver:
                                       replica=r, etype=etype,
                                       conn=conn_id, frags=len(frags),
                                       submit_seq=rt.submit_seq)
+                # causal span birth: keyed (conn, final fragment seq) —
+                # the exact pair the ack-release path matches on
+                self.obs.spans.begin(conn_id, rt.submit_seq, r)
                 self._wake.set()
                 return ev
         return on_event
@@ -606,6 +619,10 @@ class ClusterDriver:
                                  replica=rt.idx)
             self.obs.trace.record(obs_trace.INFLIGHT_FAILED,
                                   replica=rt.idx, count=n, site=site)
+            # close the failed waiters' spans with a terminal failover
+            # status — orphaned spans must never leak across leadership
+            # churn (nothing will ever ack them)
+            self.obs.spans.fail_open(rt.idx)
 
     def _step_down_detector(self, res) -> None:
         """Lost-majority step-down (dare_server.c:1213-1217 analog): a
@@ -1029,6 +1046,7 @@ class ClusterDriver:
             # ack release by sequence: every own-origin entry carries
             # the fragment seq in req_id (monotone in commit order), so
             # commits are matched exactly even across leadership churn
+            self._phase_prof.start("ack_release")
             releases = []
             with self._lock:
                 while rt.inflight and rt.inflight[0][1] <= own_max:
@@ -1046,6 +1064,8 @@ class ClusterDriver:
                 self.obs.trace.record(obs_trace.PROXY_ACK_RELEASE,
                                       replica=r, count=len(releases),
                                       submit_seq=own_max)
+                self.obs.spans.ack_release(r, own_max)
+            self._phase_prof.stop("ack_release")
 
     # ------------------------------------------------------------------
     # lifecycle

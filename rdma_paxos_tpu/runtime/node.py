@@ -36,6 +36,7 @@ from rdma_paxos_tpu.consensus.log import (
 from rdma_paxos_tpu.consensus.state import Role
 from rdma_paxos_tpu.obs import default as obs_default, trace as obs_trace
 from rdma_paxos_tpu.obs.metrics import LATENCY_BUCKETS_S
+from rdma_paxos_tpu.obs.spans import StepPhaseProfiler
 from rdma_paxos_tpu.proxy.proxy import (
     PendingEvent, ProxyServer, ReplayEngine, spec_send_refused_dirty)
 from rdma_paxos_tpu.proxy.stablestore import HardState, StableStore
@@ -115,6 +116,18 @@ class NodeDaemon:
         # obs facade (one daemon per process in deployment, so no
         # cross-instance mixing); the greppable log file is preserved
         self.obs = obs_default()
+        # step-phase attribution for the lock-step loop (host encode /
+        # device dispatch / apply / ack release). On this multi-host
+        # path hd.step's output extraction already blocks on results,
+        # so device_dispatch includes device time; RP_FENCE=1 opts into
+        # the explicit fence anyway (useful on a directly-attached TPU
+        # where extraction is lazy).
+        self._phase_prof = StepPhaseProfiler(
+            metrics=self.obs.metrics,
+            fence=os.environ.get("RP_FENCE") == "1", replica=self.me)
+        # total i32-rollover offset this incarnation applied: spans are
+        # keyed by ABSOLUTE indices, invariant across rebases
+        self._rebased_total = 0
         self.log = ReplicaLog(
             os.path.join(workdir, f"replica{self.me}.log"),
             replica=self.me, obs=self.obs)
@@ -231,6 +244,7 @@ class NodeDaemon:
                 self.submit_seq += 1
                 self._submitq.append((etype, conn_id, f, self.submit_seq))
             self.inflight.append((ev, self.submit_seq))
+            self.obs.spans.begin(conn_id, self.submit_seq, self.me)
             return ev
 
     # ------------------------------------------------------------------
@@ -247,6 +261,8 @@ class NodeDaemon:
         program); the leader clamps the batch CONTENT it actually packs
         by its local capacity, which never changes program shape."""
         B = self.cfg.batch_slots
+        prof = self._phase_prof
+        prof.start("host_encode")
         hint = (int(self.last["burst_hint"])
                 if self.last is not None
                 and self.last.get("burst_hint") is not None else 0)
@@ -275,10 +291,13 @@ class NodeDaemon:
                         in take[k * B:(k + 1) * B]] for k in range(K)]
             import time as _t
             _t0 = _t.monotonic()
+            prof.stop("host_encode")
+            prof.start("device_dispatch")
             res = self.hd.step_burst(K, batches,
                                      apply_done=self.applied,
                                      gen=self.gen,
                                      queue_depth=qdepth)
+            prof.stop("device_dispatch")
             if os.environ.get("RP_BURST_DEBUG"):
                 self.log.info_wtime(
                     "BURST K=%d take=%d dt=%.3fs" %
@@ -298,15 +317,29 @@ class NodeDaemon:
                 fire = True
                 self.timer.beat()
 
+            prof.stop("host_encode")
+            prof.start("device_dispatch")
             res = self.hd.step(batch=batch, timeout_fired=fire,
                                apply_done=self.applied, gen=self.gen,
                                queue_depth=qdepth)
+            prof.stop("device_dispatch")
             take_n = len(take)
         if take and int(res["role"]) == int(Role.LEADER):
             # ring-full shortfall: the appended set is a PREFIX of the
             # submitted rows — requeue the rest in order (a deposed
             # host's remainder is dropped; its events fail below)
             acc = int(res["accepted"]) if res["accepted"] is not None else 0
+            spans = self.obs.spans
+            if spans.open_count and acc > 0:
+                # the accepted prefix landed at absolute indices
+                # [end-acc, end): stamp each sampled span's (term,
+                # index) correlation key — this host only observes its
+                # own commit/apply frontiers (merges align cross-host)
+                end_abs = int(res["end"]) + self._rebased_total
+                term = int(res["term"])
+                for i, (_t_, c, _f, s) in enumerate(take[:acc]):
+                    spans.stamp_append(c, s, term, end_abs - acc + i,
+                                       self.me, replicas=(self.me,))
             if acc < take_n:
                 with self._lock:
                     self._submitq = take[acc:] + self._submitq
@@ -327,6 +360,8 @@ class NodeDaemon:
         commit = int(res["commit"])
         progressed = False
         releases = []
+        released_upto = -1
+        prof.start("apply")
         from rdma_paxos_tpu.consensus.log import M_GIDX
         while self.applied < commit and not self.needs_recovery:
             n = min(commit - self.applied, self.cfg.window_slots)
@@ -366,11 +401,13 @@ class NodeDaemon:
                                    and self.inflight[0][1] <= req):
                                 ev, _ = self.inflight.popleft()
                                 releases.append(ev)
+                        released_upto = max(released_upto, req)
                     elif self.replay is not None and not self.app_dirty:
                         # dirty app: persist only — replay resumes after
                         # the app is rebuilt from the committed store
                         self.replay.apply(etype, conn, payload)
             self.applied += n
+        prof.stop("apply")
         if progressed:
             if self.replay is not None:
                 self.replay.drain_responses()
@@ -378,6 +415,19 @@ class NodeDaemon:
             # precedes apply/ack): a client ack implies the event is in
             # this host's stable store
             self.store.sync()
+        # span frontiers BEFORE the ack marks (a span's commit/apply
+        # precede its ack causally — recording them after would invert
+        # the critical-path timestamps): this host observes only its
+        # own replica's frontiers, in ABSOLUTE indices, and must run
+        # before the rebase below (res offsets and _rebased_total are
+        # both still pre-rollover here); cross-host correlation happens
+        # at merge time via (term, index)
+        spans = self.obs.spans
+        if spans.open_count:
+            spans.commit_advance(self.me, commit + self._rebased_total)
+            spans.apply_advance(self.me,
+                                self.applied + self._rebased_total)
+        prof.start("ack_release")
         import time as _time
         _now = _time.perf_counter()
         for ev in releases:
@@ -389,6 +439,8 @@ class NodeDaemon:
             self.obs.trace.record(obs_trace.PROXY_ACK_RELEASE,
                                   replica=self.me,
                                   count=len(releases))
+            self.obs.spans.ack_release(self.me, released_upto)
+        prof.stop("ack_release")
         if not self._is_leader:
             with self._lock:
                 if (self.inflight and self.proxy.spec_mode
@@ -400,9 +452,14 @@ class NodeDaemon:
                     self.log.info_wtime(
                         "APP DIRTY: %d speculated events failed at "
                         "deposition" % len(self.inflight))
+                n_failed = len(self.inflight)
                 while self.inflight:
                     ev, _ = self.inflight.popleft()
                     ev.release(-1)
+                if n_failed:
+                    # deposed with blocked waiters: their spans must
+                    # close (failover), never leak
+                    self.obs.spans.fail_open(self.me)
         # coordinated i32-offset rollover: the gathered rebase_delta is
         # identical on every host under full connectivity (psum fan-out
         # — the only configuration this daemon bursts or rebases in), so
@@ -415,6 +472,7 @@ class NodeDaemon:
                 delta = int(rd)
                 self.hd.rebase(delta)
                 self.applied -= delta
+                self._rebased_total += delta
                 self._rebase_stall_steps = 0     # re-arm stall detect
                 self.obs.metrics.inc("rebases_total")
                 self.obs.trace.record(obs_trace.REBASE_APPLIED,

@@ -119,6 +119,11 @@ class SimCluster:
         # by ClusterDriver (or tests). NEVER read inside jitted code —
         # instrumentation must not change compiled-step cache keys.
         self.obs = None
+        # optional obs.spans.StepPhaseProfiler: attributes step wall
+        # time to phases (host encode / device dispatch / optional
+        # fenced device sync / quorum-wait readback / apply). Host-side
+        # only; with fence off it never blocks and never imports jax.
+        self.profiler = None
         # pluggable per-link fault model (rdma_paxos_tpu.chaos.faults
         # .LinkModel): when attached, each step's peer_mask INPUT is
         # rewritten host-side into the effective hear-matrix
@@ -245,6 +250,9 @@ class SimCluster:
         (``accepted`` aggregated over the burst)."""
         cfg, R, B = self.cfg, self.R, self.cfg.batch_slots
         assert self.last is not None, "burst requires a stepped cluster"
+        prof = self.profiler
+        if prof is not None:
+            prof.start("host_encode")
         # capacity sizing: never enqueue more than the ring can take
         # without drops, so mid-burst drops (which would reorder a
         # connection's fragments against later steps) cannot occur
@@ -285,6 +293,9 @@ class SimCluster:
                 "psum fan-out requires full connectivity; use "
                 "fanout='gather' to model partitions")
         fn = self._burst_fn(K)
+        if prof is not None:
+            prof.stop("host_encode")
+            prof.start("device_dispatch")
         self.state, outs = fn(self.state, jnp.asarray(data),
                               jnp.asarray(meta), jnp.asarray(count),
                               jnp.asarray(mask),
@@ -292,6 +303,10 @@ class SimCluster:
                               jnp.asarray(np.array(
                                   [len(q) for q in self.pending],
                                   np.int32)))
+        if prof is not None:
+            prof.stop("device_dispatch")
+            prof.sync(outs)             # fenced device_sync (opt-in)
+            prof.start("quorum_wait")
         res = {k: np.asarray(getattr(outs, k))[-1]
                for k in ("term", "role", "leader_id", "voted_term",
                          "voted_for", "head", "apply", "commit", "end",
@@ -300,6 +315,8 @@ class SimCluster:
                          "rebase_delta")}
         acc = np.asarray(outs.accepted).sum(axis=0)         # [R]
         res["accepted"] = acc
+        if prof is not None:
+            prof.stop("quorum_wait")
         # Shortfall: appends stop entirely the step the replica is not
         # leader and the capacity clamp drops suffixes only, so the
         # appended set is always a PREFIX of ``taken`` — requeue the
@@ -311,12 +328,18 @@ class SimCluster:
         for r in range(R):
             if taken[r] and res["role"][r] == int(Role.LEADER):
                 a = int(acc[r])
+                self._stamp_appends(r, taken[r], a, res)
                 if a < len(taken[r]):
                     self.pending[r] = taken[r][a:] + self.pending[r]
+        if prof is not None:
+            prof.start("apply")
         self._replay_committed(res)
+        if prof is not None:
+            prof.stop("apply")
         self._maybe_rebase(res)
         self.last = res
         self.step_index += K
+        self._observe_spans(res)
         return res
 
     def _build_step(self, *, elections: bool):
@@ -368,19 +391,31 @@ class SimCluster:
 
     def step(self, timeouts: Sequence[int] = ()) -> Dict[str, np.ndarray]:
         timeouts = list(timeouts)       # may be a one-shot iterable
+        prof = self.profiler
+        if prof is not None:
+            prof.start("host_encode")
         inp = self._build_inputs(timeouts)
         # no timer fired ⟹ Phase B is provably a no-op: dispatch the
         # stable step (bit-identical outputs, one fewer collective)
         fn = (self._build_step(elections=False)
               if self._stable_fast_path and not timeouts
               else self._step)
+        if prof is not None:
+            prof.stop("host_encode")
+            prof.start("device_dispatch")
         self.state, out = fn(self.state, inp)
+        if prof is not None:
+            prof.stop("device_dispatch")
+            prof.sync(out)              # fenced device_sync (opt-in)
+            prof.start("quorum_wait")
         res = {k: np.asarray(getattr(out, k))
                for k in ("term", "role", "leader_id", "voted_term",
                          "voted_for", "head", "apply",
                          "commit", "end", "hb_seen", "became_leader",
                          "acked", "accepted", "peer_acked",
                          "leadership_verified", "rebase_delta")}
+        if prof is not None:
+            prof.stop("quorum_wait")
         # ring-full backpressure: entries the leader could not append are
         # requeued in order (submissions to non-leaders are dropped by
         # design — proxy submits on the leader only)
@@ -389,13 +424,54 @@ class SimCluster:
             self._inflight[r] = []
             if take and res["role"][r] == int(Role.LEADER):
                 acc = int(res["accepted"][r])
+                self._stamp_appends(r, take, acc, res)
                 if acc < len(take):
                     self.pending[r] = take[acc:] + self.pending[r]
+        if prof is not None:
+            prof.start("apply")
         self._replay_committed(res)
+        if prof is not None:
+            prof.stop("apply")
         self._maybe_rebase(res)
         self.last = res
         self.step_index += 1
+        self._observe_spans(res)
         return res
+
+    # ------------------------------------------------------------------
+    # span hooks (host-side causal tracing — obs.spans; all no-ops
+    # when no recorder is attached or nothing is sampled)
+    # ------------------------------------------------------------------
+
+    def _span_recorder(self):
+        from rdma_paxos_tpu.obs.spans import active_recorder
+        return active_recorder(self.obs)
+
+    def _stamp_appends(self, r: int, take, acc: int, res) -> None:
+        """The accepted PREFIX of ``take`` landed at absolute indices
+        ``[end-acc, end)`` on leader ``r`` — stamp each sampled span
+        with its ``(term, index)`` correlation key."""
+        spans = self._span_recorder()
+        if spans is None or not spans.open_count or acc <= 0:
+            return
+        end_abs = int(res["end"][r]) + self.rebased_total
+        term = int(res["term"][r])
+        replicas = range(self.R)
+        for i, (_t, conn, req, _p) in enumerate(take[:acc]):
+            spans.stamp_append(conn, req, term, end_abs - acc + i, r,
+                               replicas=replicas)
+
+    def _observe_spans(self, res) -> None:
+        """Advance every replica's commit/apply span frontiers (absolute,
+        rebase-corrected — runs after ``_maybe_rebase`` so the offsets
+        and ``rebased_total`` are mutually consistent)."""
+        spans = self._span_recorder()
+        if spans is None or not spans.open_count:
+            return
+        rebased = self.rebased_total
+        for r in range(self.R):
+            spans.commit_advance(r, int(res["commit"][r]) + rebased)
+            spans.apply_advance(r, int(self.applied[r]) + rebased)
 
     # consecutive post-threshold zero-delta steps before the stall is
     # declared — shared with NodeDaemon (config.REBASE_STALL_STEPS)
