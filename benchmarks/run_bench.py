@@ -91,12 +91,39 @@ def main():
     ap.add_argument("--trace-json", default=None,
                     help="Chrome trace output path (default: "
                          "<workdir>/trace.perfetto.json)")
+    ap.add_argument("--groups", type=int, default=0,
+                    help="sharded mode: delegate to benchmarks/"
+                         "shard_bench.py with G=<n> consensus groups "
+                         "(the multi-group one-dispatch bench; the "
+                         "e2e app path below is single-group)")
     ap.add_argument("--fence", action="store_true",
                     help="fence each device step with block_until_ready "
                          "so step-phase histograms attribute device-sync "
                          "time separately from dispatch (profiling mode; "
                          "serializes the dispatch pipeline)")
     args = ap.parse_args()
+
+    if args.groups:
+        # --groups N pass-through: the sharded sweep owns its own
+        # cluster lifecycle, so hand the whole run to shard_bench.
+        # The e2e-only flags have no sharded equivalent — refuse them
+        # loudly rather than silently dropping an explicit request.
+        dropped = [flag for flag, on in (
+            ("--trace", args.trace), ("--fence", args.fence),
+            ("--trace-json", args.trace_json),
+            ("--metrics-json", args.metrics_json),
+            ("--threaded-app", args.threaded_app)) if on]
+        if dropped:
+            raise SystemExit(
+                f"--groups delegates to benchmarks/shard_bench.py, "
+                f"which does not support {', '.join(dropped)}; run "
+                f"shard_bench.py directly or drop the flag(s)")
+        from benchmarks.shard_bench import main as shard_main
+        fwd = ["--groups", str(args.groups),
+               "--replicas", str(args.replicas)]
+        if args.json:
+            fwd += ["--json", args.json]
+        return shard_main(fwd)
 
     os.environ.setdefault(
         "XLA_FLAGS", "--xla_force_host_platform_device_count=8")

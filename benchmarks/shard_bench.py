@@ -1,0 +1,159 @@
+#!/usr/bin/env python
+"""Sharded multi-group throughput — the one-dispatch-per-step win.
+
+Scales the group count G over {1, 2, 4, 8} (default) and measures
+aggregate committed ops/s across ALL groups of a
+:class:`~rdma_paxos_tpu.shard.cluster.ShardedCluster`, under a
+saturating closed-loop workload (every group's leader fed a full batch
+per step). The headline proof is the **dispatch count**: the
+group-batched compiled step advances all G groups in ONE device
+dispatch per protocol step — ``dispatch_per_step == 1.0`` regardless
+of G — so aggregate throughput scales with G without multiplying host
+dispatch overhead (the G-separate-clusters alternative pays G
+dispatches per step).
+
+Leaders are spread round-robin across the R replicas
+(``place_leaders``), matching the production placement policy.
+
+    python benchmarks/shard_bench.py --groups 1,2,4,8 --steps 60
+
+Emits one standardized ``BENCH:`` line per G plus a scaling summary
+(``benchmarks/reporting.emit``), and appends full registry-snapshot
+rows to ``--json`` when given.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def run_one(G: int, *, replicas: int, steps: int, payload: int,
+            burst: bool, json_path, cfg=None):
+    """Build, warm, and drive one G-group cluster; returns the result
+    row dict (also emitted as a BENCH: line)."""
+    from benchmarks.reporting import emit
+    from rdma_paxos_tpu.config import LogConfig
+    from rdma_paxos_tpu.obs import Observability
+    from rdma_paxos_tpu.shard import ShardedCluster
+
+    if cfg is None:
+        cfg = LogConfig(n_slots=2048, slot_bytes=128,
+                        window_slots=256, batch_slots=256)
+    sc = ShardedCluster(cfg, replicas, G)
+    sc.obs = Observability()
+    targets = sc.place_leaders()
+    B = cfg.batch_slots
+    blob = b"x" * payload
+
+    def feed():
+        for g in range(G):
+            lead = sc.leader_hint(g)
+            for i in range(B):
+                sc.submit(g, lead, blob)
+
+    # warmup: compile both step variants (and the burst tiers when the
+    # burst driver is measured) outside the timed window
+    if burst:
+        sc.prewarm()
+    feed()
+    sc.step()
+    feed()
+    sc.step()
+
+    base_commit = [int(sc.last["commit"][g].max())
+                   + int(sc.rebased_total[g]) for g in range(G)]
+    d0, f0 = sc.dispatches, sc.fetch_dispatches
+    n_dispatch_steps = 0
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        feed()
+        if burst:
+            sc.step_burst()
+        else:
+            sc.step()
+        n_dispatch_steps += 1
+    dt = time.perf_counter() - t0
+    per_group = [int(sc.last["commit"][g].max())
+                 + int(sc.rebased_total[g]) - base_commit[g]
+                 for g in range(G)]
+    committed = sum(per_group)
+    dispatches = sc.dispatches - d0
+    row = emit(
+        "shard_aggregate_committed_ops_per_sec",
+        round(committed / dt, 1), "ops/s",
+        detail=dict(
+            groups=G, replicas=replicas, steps=steps,
+            driver=("burst" if burst else "step"),
+            seconds=round(dt, 3),
+            committed_total=committed,
+            committed_per_group=per_group,
+            leaders=targets,
+            protocol_dispatches=dispatches,
+            dispatch_per_step=round(dispatches
+                                    / max(n_dispatch_steps, 1), 3),
+            replay_fetch_dispatches=sc.fetch_dispatches - f0,
+            compiled_programs_used=len(sc.programs_used),
+        ),
+        obs=sc.obs, json_path=json_path)
+    print(f"  G={G}: {committed} committed in {dt:.2f}s -> "
+          f"{committed / dt:.0f} ops/s aggregate; "
+          f"{dispatches} dispatches / {n_dispatch_steps} steps = "
+          f"{dispatches / max(n_dispatch_steps, 1):.2f} per step; "
+          f"leaders {targets}")
+    return row
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--groups", default="1,2,4,8",
+                    help="comma-separated group counts to sweep")
+    ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--steps", type=int, default=60,
+                    help="timed protocol steps per group count")
+    ap.add_argument("--payload", type=int, default=64,
+                    help="bytes per committed entry")
+    ap.add_argument("--burst", action="store_true",
+                    help="drive with fused multi-step bursts "
+                         "(step_burst) instead of single steps")
+    ap.add_argument("--json", default=None,
+                    help="append JSON result rows to this file")
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/rp_jax_cache")
+    import jax
+    if os.environ.get("RP_BENCH_CPU", "1") == "1":
+        jax.config.update("jax_platforms", "cpu")
+
+    from benchmarks.reporting import emit
+
+    gs = [int(g) for g in str(args.groups).split(",") if g]
+    print(f"shard_bench: G sweep {gs}, R={args.replicas}, "
+          f"{args.steps} steps, "
+          f"driver={'burst' if args.burst else 'step'}")
+    scaling = {}
+    for G in gs:
+        row = run_one(G, replicas=args.replicas, steps=args.steps,
+                      payload=args.payload, burst=args.burst,
+                      json_path=args.json)
+        scaling[G] = row
+    emit("shard_scaling",
+         detail={str(G): dict(
+             ops_per_sec=scaling[G]["value"],
+             dispatch_per_step=scaling[G]["detail"]["dispatch_per_step"])
+             for G in gs},
+         json_path=args.json)
+    base = gs[0]
+    for G in gs[1:]:
+        speedup = scaling[G]["value"] / max(scaling[base]["value"], 1e-9)
+        print(f"  aggregate G={G} vs G={base}: {speedup:.2f}x")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

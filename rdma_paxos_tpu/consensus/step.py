@@ -747,6 +747,51 @@ def replica_step(
     return new_state, out
 
 
+def group_step(
+    *,
+    cfg: LogConfig,
+    n_replicas: int,
+    axis_name: str = "replica",
+    use_pallas: bool = False,
+    interpret: bool = False,
+    fanout: str = "gather",
+    elections: bool = True,
+):
+    """The group-batched protocol step: G independent consensus groups
+    advanced by ONE program.
+
+    :func:`replica_step` is documented as vmappable over the replica
+    axis; sharding the keyspace across G groups adds a second,
+    *unnamed* leading ``group`` batch axis. Groups are fully
+    independent state machines — no collective may ever cross the
+    group axis — so the outer ``vmap`` carries no axis name and XLA
+    simply widens every tensor op and every replica-axis collective by
+    a factor of G. G groups therefore replicate in ONE compiled
+    dispatch instead of G (the sharded-throughput win
+    ``benchmarks/shard_bench.py`` measures).
+
+    Takes/returns pytrees with leading axes ``[group, replica, ...]``.
+
+    CACHE-KEY GUARD: everything that shapes the compiled program is in
+    this signature — the group count G deliberately is NOT. The
+    returned callable is batch-size-polymorphic until ``jit``
+    specializes it on the input shapes, so a homogeneous
+    ``ShardedCluster`` (G groups sharing one ``LogConfig``) runs all
+    its groups through exactly ONE compiled program per step variant,
+    cached once in the shared runtime step cache
+    (``runtime/sim.py:STEP_CACHE``; ``tests/test_shard.py`` proves the
+    single-compile property).
+    """
+    import functools
+
+    core = functools.partial(
+        replica_step, cfg=cfg, n_replicas=n_replicas,
+        axis_name=axis_name, use_pallas=use_pallas,
+        interpret=interpret, fanout=fanout, elections=elections)
+    vstep = jax.vmap(core, in_axes=(0, 0), axis_name=axis_name)
+    return jax.vmap(vstep, in_axes=(0, 0))
+
+
 def fetch_window(log: Log, start: jax.Array, *, window_slots: int):
     """Host helper: gather ``window_slots`` entries beginning at ``start`` —
     used by the driver to read newly committed payloads for replay/persist

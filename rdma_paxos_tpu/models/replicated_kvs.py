@@ -30,10 +30,21 @@ from rdma_paxos_tpu.runtime.sim import SimCluster
 
 
 class ReplicatedKVS:
-    """KVS service over a :class:`SimCluster` (or a driver's cluster)."""
+    """KVS service over a :class:`SimCluster` (or a driver's cluster).
+
+    ``cluster`` is duck-typed: any engine exposing the SimCluster
+    client surface (``R``, ``submit``, ``replayed``, ``last``,
+    ``obs``) works — the sharded layer reuses this class per group
+    through exactly such a facade
+    (:class:`rdma_paxos_tpu.shard.kvs._GroupFacade`), so sharding adds
+    routing without forking the state-machine fold."""
 
     def __init__(self, cluster: SimCluster, cap: int = 4096):
         self.c = cluster
+        # consensus group this instance serves (set by ShardedKVS);
+        # labels the dedup metric series so per-group dedup pressure
+        # is observable — None = unsharded, unlabeled legacy series
+        self.group: Optional[int] = None
         self.tables: List[KVState] = [make_kvs(cap)
                                       for _ in range(cluster.R)]
         self._cursor = [0] * cluster.R
@@ -60,6 +71,15 @@ class ReplicatedKVS:
         (so the sim's append hook correlates them with (term, index))."""
         from rdma_paxos_tpu.obs.spans import active_recorder
         return active_recorder(getattr(self.c, "obs", None))
+
+    def _span_rep(self, r: int) -> int:
+        """Span-track replica id for local replica ``r``: the cluster
+        may namespace replica ids (the sharded engine uses ``g*R + r``
+        so per-group tracks never collide) — every span event this
+        layer records must use the SAME namespace the cluster's
+        append/commit/apply stamps use."""
+        f = getattr(self.c, "span_replica", None)
+        return f(r) if f is not None else r
 
     # ------------------------------------------------------------------
 
@@ -91,6 +111,14 @@ class ReplicatedKVS:
                 # session-stamped command: apply exactly once
                 if req <= self.last_req[r].get(conn, 0):
                     self.deduped[r] += 1
+                    obs = getattr(self.c, "obs", None)
+                    if obs is not None:
+                        if self.group is not None:
+                            obs.metrics.inc("kvs_deduped_total",
+                                            replica=r, group=self.group)
+                        else:
+                            obs.metrics.inc("kvs_deduped_total",
+                                            replica=r)
                     continue
                 self.last_req[r][conn] = req
             cmd = jnp.asarray(np.frombuffer(payload, "<i4"))
@@ -177,8 +205,8 @@ class ClientSession:
                                     req_id=self.req_id, replica=leader)
         spans = self.kvs._spans()
         if spans is not None:
-            spans.begin(self.client_id, self.req_id, leader,
-                        phase="submit")
+            spans.begin(self.client_id, self.req_id,
+                        self.kvs._span_rep(leader), phase="submit")
         self.kvs.put(leader, key, val, client_id=self.client_id,
                      req_id=self.req_id)
         return self.req_id
@@ -190,8 +218,8 @@ class ClientSession:
                                     req_id=self.req_id, replica=leader)
         spans = self.kvs._spans()
         if spans is not None:
-            spans.begin(self.client_id, self.req_id, leader,
-                        phase="submit")
+            spans.begin(self.client_id, self.req_id,
+                        self.kvs._span_rep(leader), phase="submit")
         self.kvs.remove(leader, key, client_id=self.client_id,
                         req_id=self.req_id)
         return self.req_id
@@ -208,6 +236,7 @@ class ClientSession:
         if spans is not None:
             # same (client, req) key -> same span: a retransmit is the
             # same logical command, recorded as a retransmit mark
-            spans.begin(self.client_id, req_id, leader, phase="submit")
+            spans.begin(self.client_id, req_id,
+                        self.kvs._span_rep(leader), phase="submit")
         self.kvs.put(leader, key, val, client_id=self.client_id,
                      req_id=req_id)

@@ -92,7 +92,8 @@ class _Span:
     """One sampled command's causal record (host bookkeeping only)."""
 
     __slots__ = ("conn", "req", "origin", "term", "index", "leader",
-                 "status", "retransmits", "pending_marks", "events")
+                 "group", "status", "retransmits", "pending_marks",
+                 "events")
 
     def __init__(self, conn: int, req: int, origin: int):
         self.conn = conn
@@ -101,6 +102,7 @@ class _Span:
         self.term: Optional[int] = None
         self.index: Optional[int] = None
         self.leader: Optional[int] = None
+        self.group = -1                # consensus group (-1: unsharded)
         self.status = OPEN
         self.retransmits = 0
         # commit+apply marks still expected (2 per correlated replica);
@@ -109,10 +111,15 @@ class _Span:
         self.events: List[Tuple[str, int, float]] = []  # (phase, rep, ts)
 
     def as_dict(self) -> dict:
-        return dict(conn=self.conn, req=self.req, origin=self.origin,
-                    term=self.term, index=self.index, leader=self.leader,
-                    status=self.status, retransmits=self.retransmits,
-                    events=[[p, r, t] for (p, r, t) in self.events])
+        d = dict(conn=self.conn, req=self.req, origin=self.origin,
+                 term=self.term, index=self.index, leader=self.leader,
+                 status=self.status, retransmits=self.retransmits,
+                 events=[[p, r, t] for (p, r, t) in self.events])
+        if self.group >= 0:
+            # sharded spans carry their group; unsharded dumps keep the
+            # pre-sharding schema byte-for-byte (golden-file pinned)
+            d["group"] = self.group
+        return d
 
 
 class SpanRecorder:
@@ -149,8 +156,9 @@ class SpanRecorder:
         self._done_pending: "collections.OrderedDict" = \
             collections.OrderedDict()
         self.dropped = 0                   # samples refused at capacity
-        # (term, index) -> key, for cross-replica correlation queries
-        self._by_ti: Dict[Tuple[int, int], Tuple[int, int]] = {}
+        # (group, term, index) -> key, for cross-replica correlation
+        # queries (group -1 = unsharded single-group callers)
+        self._by_ti: Dict[Tuple[int, int, int], Tuple[int, int]] = {}
         # per-replica frontier heaps: (abs_index, key)
         self._await_commit: Dict[int, list] = {}
         self._await_apply: Dict[int, list] = {}
@@ -231,7 +239,8 @@ class SpanRecorder:
 
     def stamp_append(self, conn: int, req: int, term: int, index: int,
                      leader: int,
-                     replicas: Sequence[int] = ()) -> None:
+                     replicas: Sequence[int] = (),
+                     group: int = -1) -> None:
         """The leader appended this command at absolute ``index`` in
         ``term`` — the cross-replica correlation key. ``replicas``
         lists the replica ids whose commit/apply frontiers this
@@ -240,7 +249,13 @@ class SpanRecorder:
         (plus the client ack). A second append of the same key (a
         committed duplicate from a retransmit) is recorded but the
         FIRST (term, index) wins — first-commit order is the one the
-        state machine deduplicates to."""
+        state machine deduplicates to.
+
+        ``group`` namespaces the correlation key for sharded clusters:
+        ``(term, index)`` is unique within ONE consensus group but G
+        independent groups number terms and indices identically, so
+        the full key is ``(group, term, index)`` (-1 for unsharded
+        callers — the legacy key, unchanged)."""
         if not self._open:
             return
         with self._lock:
@@ -253,9 +268,10 @@ class SpanRecorder:
                 sp.events.append((RETRANSMIT, leader, ts))
                 return
             sp.term, sp.index, sp.leader = int(term), int(index), leader
+            sp.group = int(group)
             sp.events.append((APPEND, leader, ts))
             key = (conn, req)
-            self._by_ti[(sp.term, sp.index)] = key
+            self._by_ti[(sp.group, sp.term, sp.index)] = key
             sp.pending_marks = 2 * len(replicas)
             for r in replicas:
                 hc = self._await_commit.setdefault(r, [])
@@ -379,14 +395,15 @@ class SpanRecorder:
         self._open.pop(key, None)
         self._done_pending.pop(key, None)
         if sp.term is not None:
-            self._by_ti.pop((sp.term, sp.index), None)
+            self._by_ti.pop((sp.group, sp.term, sp.index), None)
         self._done.append(sp)
 
     # ---------------- queries / export ----------------
 
-    def key_for(self, term: int, index: int) -> Optional[Tuple[int, int]]:
+    def key_for(self, term: int, index: int,
+                group: int = -1) -> Optional[Tuple[int, int]]:
         with self._lock:
-            return self._by_ti.get((int(term), int(index)))
+            return self._by_ti.get((int(group), int(term), int(index)))
 
     def counts(self) -> dict:
         with self._lock:

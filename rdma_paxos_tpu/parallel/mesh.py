@@ -64,6 +64,17 @@ def stack_states(cfg: LogConfig, n_replicas: int, group_size: int
         lambda x: jnp.broadcast_to(x, (n_replicas,) + x.shape), one)
 
 
+def stack_group_states(cfg: LogConfig, n_groups: int, n_replicas: int,
+                       group_size: int) -> ReplicaState:
+    """Batched initial state for a sharded multi-group cluster: every
+    leaf gains leading ``[group, replica]`` axes. All G groups start
+    from the identical per-replica state — divergence comes only from
+    per-group inputs (timeouts, batches, masks)."""
+    one = stack_states(cfg, n_replicas, group_size)
+    return jax.tree.map(
+        lambda x: jnp.broadcast_to(x, (n_groups,) + x.shape), one)
+
+
 def _squeeze(tree):
     return jax.tree.map(lambda x: x[0], tree)
 
@@ -193,6 +204,58 @@ def build_spmd_burst(cfg: LogConfig, n_replicas: int, mesh: Mesh, *,
                   P(REPLICA_AXIS), P(REPLICA_AXIS), P(REPLICA_AXIS)),
         out_specs=(P(REPLICA_AXIS), P(None, REPLICA_AXIS)))
     return jax.jit(mapped, donate_argnums=(0,) if donate else ())
+
+
+def build_sim_group_step(cfg: LogConfig, n_replicas: int, *,
+                         use_pallas: bool = False, interpret: bool = False,
+                         donate: bool = True, fanout: str = "gather",
+                         elections: bool = True):
+    """Compile the G-group × R-replica protocol step as ONE program on
+    one device (:func:`rdma_paxos_tpu.consensus.step.group_step` under
+    ``jit``). The group axis is an unnamed batch axis — groups are
+    independent; only the replica axis carries collectives — so one
+    dispatch steps every group (the sharded-cluster hot path)."""
+    from rdma_paxos_tpu.consensus.step import group_step
+    mapped = group_step(cfg=cfg, n_replicas=n_replicas,
+                        axis_name=REPLICA_AXIS, use_pallas=use_pallas,
+                        interpret=interpret, fanout=fanout,
+                        elections=elections)
+    return jax.jit(mapped, donate_argnums=(0,) if donate else ())
+
+
+def build_sim_group_burst(cfg: LogConfig, n_replicas: int, *,
+                          use_pallas: bool = False,
+                          interpret: bool = False,
+                          donate: bool = True, fanout: str = "gather"):
+    """:func:`build_sim_burst` with a leading ``group`` batch axis: K
+    fused protocol steps over ALL G groups in ONE dispatch
+    (``lax.scan`` of the group-batched stable step). Same contract as
+    the single-group burst — no elections inside the burst, host apply
+    cursors frozen across it, capacity sized by the caller — applied
+    per group. Inputs: datas ``[K, G, R, B, sw]``, metas
+    ``[K, G, R, B, MW]``, counts ``[K, G, R]``, peer_mask
+    ``[G, R, R]``, applied/qdepth ``[G, R]``."""
+    import jax.numpy as jnp
+    from jax import lax
+    from rdma_paxos_tpu.consensus.step import group_step
+
+    gstep = group_step(cfg=cfg, n_replicas=n_replicas,
+                       axis_name=REPLICA_AXIS, use_pallas=use_pallas,
+                       interpret=interpret, fanout=fanout,
+                       elections=False)
+
+    def burst(state_gb, datas, metas, counts, peer_mask, applied, qdepth):
+        zeros_gr = jnp.zeros_like(counts[0])
+
+        def body(st, xs):
+            d, m, c = xs
+            inp = StepInput(
+                batch_data=d, batch_meta=m, batch_count=c,
+                timeout_fired=zeros_gr, peer_mask=peer_mask,
+                apply_done=applied, queue_depth=qdepth)
+            return gstep(st, inp)
+        return lax.scan(body, state_gb, (datas, metas, counts))
+    return jax.jit(burst, donate_argnums=(0,) if donate else ())
 
 
 def build_sim_step(cfg: LogConfig, n_replicas: int, *,

@@ -29,12 +29,22 @@ from rdma_paxos_tpu.parallel.mesh import (
 from rdma_paxos_tpu.utils.codec import bytes_to_words
 
 
+# Compiled steps are shared across ALL cluster engines (same static
+# config ⇒ same XLA program); without this every cluster re-traces the
+# protocol. Module-level so the sharded multi-group engine
+# (rdma_paxos_tpu.shard.cluster.ShardedCluster) and SimCluster share
+# ONE cache — a G-group cluster and a single-group cluster built from
+# the same LogConfig never compile the same program twice, and tests
+# can assert cache-key sets across both engines.
+STEP_CACHE: Dict[tuple, object] = {}
+
+
 class SimCluster:
     """N-replica protocol simulation with host-side bookkeeping."""
 
-    # compiled steps are shared across clusters (same static config ⇒ same
-    # XLA program); without this every cluster re-traces the protocol
-    _STEP_CACHE: Dict[tuple, object] = {}
+    # legacy alias (tests and callers key off the class attribute);
+    # the SAME dict object as the module-level shared cache
+    _STEP_CACHE: Dict[tuple, object] = STEP_CACHE
 
     def __init__(self, cfg: LogConfig, n_replicas: int,
                  group_size: Optional[int] = None, *, mode: str = "sim",

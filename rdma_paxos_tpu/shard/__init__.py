@@ -1,0 +1,31 @@
+"""Sharded multi-group consensus — the layer above the single-group
+stack that partitions a keyspace across G independent Raft groups.
+
+* :mod:`~rdma_paxos_tpu.shard.router` — deterministic key→group
+  mapping: FNV-1a hash ring (fixed group count) + explicit
+  range-override table; serialized into health snapshots.
+* :mod:`~rdma_paxos_tpu.shard.cluster` — :class:`ShardedCluster`:
+  G × R state stacked along a leading ``group`` axis, every group
+  stepped by ONE compiled dispatch (the group-batched
+  ``consensus.step.group_step``); per-group commit/apply frontiers,
+  elections, rebase, and fault domains on the host side; leader
+  placement spreading G leaderships across the R replicas.
+* :mod:`~rdma_paxos_tpu.shard.kvs` — :class:`ShardedKVS` +
+  :class:`ShardedSession`: routed puts/gets/removes, per-group dedup
+  sequence numbers, per-group leader failover.
+* :mod:`~rdma_paxos_tpu.shard.chaos` — :class:`ShardNemesisRunner`:
+  crash one group's leader, prove the other groups never notice
+  (I1–I5 per group + strict frontier advance).
+
+Single-group remains the G=1 special case of this machinery —
+``tests/test_shard.py`` pins bit-identical behavior against
+``SimCluster`` — and G groups sharing one ``LogConfig`` share one
+compiled step through the runtime's shared cache.
+"""
+
+from rdma_paxos_tpu.shard.cluster import ShardedCluster
+from rdma_paxos_tpu.shard.kvs import ShardedKVS, ShardedSession
+from rdma_paxos_tpu.shard.router import KeyRouter, RangeRule, fnv1a32
+
+__all__ = ["ShardedCluster", "ShardedKVS", "ShardedSession",
+           "KeyRouter", "RangeRule", "fnv1a32"]

@@ -1,0 +1,179 @@
+"""Shard nemesis — fault isolation across consensus groups, proven.
+
+The single-group :class:`~rdma_paxos_tpu.chaos.runner.NemesisRunner`
+answers "does one group survive faults?"; the sharded layer must also
+answer "does a fault in one group stay IN that group?". This runner
+drives a :class:`~rdma_paxos_tpu.shard.cluster.ShardedCluster` +
+:class:`~rdma_paxos_tpu.shard.kvs.ShardedKVS` workload, crashes the
+leader of ONE target group mid-run (fail-stop via the chaos
+subsystem's :class:`~rdma_paxos_tpu.chaos.faults.LinkModel`, attached
+to that group only), re-elects after a timeout, and verdicts:
+
+* the existing **I1–I5 protocol invariants hold PER GROUP** — one
+  :class:`~rdma_paxos_tpu.chaos.invariants.InvariantChecker` per
+  group over that group's ``[R]`` result slices, convergence checked
+  over that group's replay streams;
+* the untouched groups' **commit frontiers keep strictly advancing
+  through the victim group's outage** (fault isolation — the whole
+  point of per-group fault domains);
+* the victim group **recovers** (new leader, frontier advances again)
+  without any other group noticing.
+
+Determinism: all randomness derives from the run seed; time is the
+logical step counter — same seed, same verdict (the chaos
+subsystem's reproducibility contract).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Dict, List, Optional
+
+from rdma_paxos_tpu.chaos.faults import LinkModel
+from rdma_paxos_tpu.chaos.invariants import (
+    InvariantChecker, InvariantViolation)
+from rdma_paxos_tpu.chaos.runner import DEFAULT_KV_CFG
+from rdma_paxos_tpu.config import LogConfig
+from rdma_paxos_tpu.shard.cluster import ShardedCluster
+from rdma_paxos_tpu.shard.kvs import ShardedKVS
+
+
+def keys_for_groups(router, per_group: int,
+                    prefix: bytes = b"key") -> List[List[bytes]]:
+    """Deterministically enumerate ``prefix%d`` keys until every group
+    owns ``per_group`` of them — the seeded workload's routing table."""
+    out: List[List[bytes]] = [[] for _ in range(router.n_groups)]
+    i = 0
+    while any(len(ks) < per_group for ks in out):
+        key = prefix + b"%d" % i
+        g = router.group_of(key)
+        if len(out[g]) < per_group:
+            out[g].append(key)
+        i += 1
+        if i > 100000:
+            raise RuntimeError("router starved a group of keys")
+    return out
+
+
+class ShardNemesisRunner:
+    """One seeded leader-crash run over a fresh sharded cluster."""
+
+    def __init__(self, cfg: Optional[LogConfig] = None,
+                 n_replicas: int = 3, n_groups: int = 4, *,
+                 seed: int = 0, steps: int = 60, crash_step: int = 20,
+                 reelect_after: int = 4, target_group: int = 0,
+                 settle_steps: int = 12, keys_per_group: int = 2,
+                 obs=None):
+        self.cfg = cfg or DEFAULT_KV_CFG
+        self.R, self.G = int(n_replicas), int(n_groups)
+        self.seed = int(seed)
+        self.steps = int(steps)
+        self.crash_step = int(crash_step)
+        self.reelect_after = int(reelect_after)
+        self.target = int(target_group)
+        self.settle_steps = int(settle_steps)
+        self.shard = ShardedCluster(self.cfg, self.R, self.G)
+        self.shard.obs = obs
+        self.kv = ShardedKVS(self.shard, cap=256)
+        # the fault domain is ONE group: the link model is attached to
+        # the target group only — other groups' masks are never touched
+        self.link = LinkModel(self.R, seed=seed)
+        self.shard.link_models[self.target] = self.link
+        self.checkers = [InvariantChecker(self.R)
+                         for _ in range(self.G)]
+        self.keys = keys_for_groups(self.kv.router, keys_per_group)
+        self.rng = random.Random(f"shard-nemesis:{seed}")
+        self._vn = 0
+
+    # ------------------------------------------------------------------
+
+    def _frontiers(self) -> List[int]:
+        """Per-group ABSOLUTE max commit frontier (rebase-corrected)."""
+        res = self.shard.last
+        return [int(res["commit"][g].max())
+                + int(self.shard.rebased_total[g])
+                for g in range(self.G)]
+
+    def _issue(self) -> None:
+        """One closed-loop put per group per step at that group's
+        current best-known leader (crashed-leader submissions land on
+        an isolated claimant and stall — exactly the client experience
+        of an outage)."""
+        for g in range(self.G):
+            lead = self.shard.leader_hint(g)
+            if lead < 0:
+                continue
+            key = self.rng.choice(self.keys[g])
+            self._vn += 1
+            self.kv.groups[g].put(lead, key, b"v%d" % self._vn)
+
+    def _check(self, res, t: int, violations: List[dict]) -> None:
+        for g in range(self.G):
+            try:
+                self.checkers[g].check_step(
+                    {k: res[k][g] for k in ("commit", "role", "term",
+                                            "head", "apply", "end")},
+                    step=t,
+                    rebased_total=int(self.shard.rebased_total[g]))
+            except InvariantViolation as v:
+                d = v.as_dict()
+                d["group"] = g
+                violations.append(d)
+
+    def run(self) -> Dict:
+        violations: List[dict] = []
+        self.shard.place_leaders()
+        crashed = -1
+        timeouts: Dict[int, list] = {}
+        f_at_crash: List[int] = []
+        f_at_heal: List[int] = []
+        for t in range(self.steps):
+            timeouts = {}
+            if t == self.crash_step:
+                crashed = self.shard.leader_hint(self.target)
+                self.link.down.add(crashed)        # fail-stop, silent
+                f_at_crash = self._frontiers()
+            if crashed >= 0 and t == self.crash_step + self.reelect_after:
+                # a surviving member's election timer fires
+                cand = next(r for r in range(self.R)
+                            if r != crashed)
+                timeouts[self.target] = [cand]
+            self._issue()
+            res = self.shard.step(timeouts=timeouts)
+            self._check(res, t, violations)
+        f_at_heal = self._frontiers()
+        # settle: the crashed replica rejoins (state intact — a long
+        # isolation, the fail-stop model crash_replica uses) and every
+        # group converges
+        self.link.down.discard(crashed)
+        self.link.heal()
+        for t in range(self.steps, self.steps + self.settle_steps):
+            res = self.shard.step()
+            self._check(res, t, violations)
+        f_end = self._frontiers()
+        for g in range(self.G):
+            try:
+                self.checkers[g].check_convergence(
+                    self.shard.replayed[g])
+            except InvariantViolation as v:
+                d = v.as_dict()
+                d["group"] = g
+                violations.append(d)
+        others = [g for g in range(self.G) if g != self.target]
+        others_advanced = all(f_at_heal[g] > f_at_crash[g]
+                              for g in others)
+        target_recovered = (f_end[self.target]
+                            > f_at_crash[self.target])
+        new_leader = self.shard.leader_hint(self.target)
+        ok = (not violations and others_advanced and target_recovered
+              and new_leader >= 0 and new_leader != crashed)
+        return dict(
+            ok=ok, seed=self.seed, steps=self.steps,
+            target_group=self.target, crashed_leader=crashed,
+            new_leader=new_leader,
+            invariant_violations=violations,
+            frontiers=dict(at_crash=f_at_crash, at_heal=f_at_heal,
+                           at_end=f_end),
+            others_advanced=others_advanced,
+            target_recovered=target_recovered,
+        )

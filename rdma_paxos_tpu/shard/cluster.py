@@ -1,0 +1,698 @@
+"""Sharded multi-group consensus cluster — G independent Raft groups,
+ONE compiled dispatch per step.
+
+``SimCluster`` drives one consensus group; production-scale serving
+partitions the keyspace across many. This engine stacks G independent
+``(Log, HardState, peer_mask, timers)`` pytrees along a leading
+``group`` axis and steps ALL of them with the group-batched protocol
+step (:func:`rdma_paxos_tpu.consensus.step.group_step` — an unnamed
+``vmap`` over groups around the named replica-axis ``vmap``), the way
+SmartNIC replication stacks multiplex many replicated partitions onto
+one device (PAPERS.md, arXiv:2503.18093). Device work per step is one
+program of G× the single-group tensor shapes; host work (commit/apply
+frontiers, replay, requeue, rebase, leader tracking) stays per-group.
+
+Single-group is the G=1 special case, not a parallel code path: the
+same ``replica_step`` core, the same host bookkeeping rules, the same
+shared compile cache (``runtime/sim.py:STEP_CACHE``) —
+``tests/test_shard.py`` pins bit-identical G=1 ≡ ``SimCluster``
+behavior on a recorded workload.
+
+Fault domains: every group has its own ``peer_mask[g]`` (and optional
+per-group chaos ``LinkModel``), its own elections, its own rebase
+clock. Crashing one group's leader cannot disturb any other group —
+the fault-isolation property the shard nemesis proves.
+
+Leader placement: G leaderships piling onto replica 0 would make one
+host the leader for every shard; :meth:`place_leaders` spreads them
+round-robin (or least-loaded) across the R replicas via targeted
+election timeouts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from rdma_paxos_tpu.config import LogConfig, REBASE_STALL_STEPS
+from rdma_paxos_tpu.consensus.log import (
+    EntryType, Log, M_CONN, M_GIDX, M_LEN, M_REQID, M_TYPE, META_W)
+from rdma_paxos_tpu.consensus.state import Role
+from rdma_paxos_tpu.consensus.step import StepInput, fetch_window
+from rdma_paxos_tpu.parallel.mesh import (
+    build_sim_group_burst, build_sim_group_step, stack_group_states)
+from rdma_paxos_tpu.runtime.sim import STEP_CACHE, SimCluster
+from rdma_paxos_tpu.shard.router import KeyRouter
+from rdma_paxos_tpu.utils.codec import bytes_to_words
+
+# step() result keys pulled to host numpy each dispatch — the same set
+# SimCluster materializes, so per-group slices are drop-in res dicts
+_RES_KEYS = ("term", "role", "leader_id", "voted_term", "voted_for",
+             "head", "apply", "commit", "end", "hb_seen",
+             "became_leader", "acked", "accepted", "peer_acked",
+             "leadership_verified", "rebase_delta")
+
+TimeoutsLike = Union[None, Dict[int, Sequence[int]],
+                     Sequence[Tuple[int, int]]]
+
+
+class ShardedCluster:
+    """G-group × R-replica protocol simulation, one dispatch per step.
+
+    Host-bookkeeping parity ledger vs ``SimCluster`` (the per-group
+    rules are the same ones, widened by a group index; any change to
+    SimCluster's step/requeue/replay/rebase logic must be mirrored
+    here — the G=1 bit-equivalence test in ``tests/test_shard.py``
+    catches drift in everything it exercises): deliberately NOT
+    carried over are ``collect_frames``/``frames`` (store-ready frame
+    assembly — the sharded engine has no driver/StableStore
+    integration yet, see ROADMAP) and the ``StepPhaseProfiler`` hooks
+    (single-group profiling covers the shared step path). Unifying
+    the two engines' host bookkeeping behind one helper is a ROADMAP
+    open item."""
+
+    K_TIERS = SimCluster.K_TIERS
+    REBASE_STALL_STEPS = REBASE_STALL_STEPS
+
+    def __init__(self, cfg: LogConfig, n_replicas: int, n_groups: int,
+                 *, router: Optional[KeyRouter] = None,
+                 use_pallas: Optional[bool] = None,
+                 interpret: bool = False, fanout: str = "gather",
+                 stable_fast_path: bool = True,
+                 group_size: Optional[int] = None):
+        if n_groups < 1:
+            raise ValueError("n_groups must be >= 1")
+        self.cfg = cfg
+        self.R = int(n_replicas)
+        self.G = int(n_groups)
+        self.group_size = group_size or n_replicas
+        self.router = (router if router is not None
+                       else KeyRouter(self.G))
+        if use_pallas is None:
+            use_pallas = jax.default_backend() == "tpu"
+        self._use_pallas = use_pallas
+        self._interpret = interpret
+        self._fanout = fanout
+        self._stable_fast_path = stable_fast_path
+        self.state = stack_group_states(cfg, self.G, self.R,
+                                        self.group_size)
+        self._step_full = self._build_step(elections=True)
+        # compile-count accounting: every shared-cache key this cluster
+        # dispatches through (the single-compile guard's witness)
+        self.programs_used: set = set()
+        # device dispatch counters: protocol steps (the one-dispatch-
+        # per-step claim shard_bench proves) and replay fetch sweeps
+        self.dispatches = 0
+        self.fetch_dispatches = 0
+        self._replay_W = min(cfg.n_slots // 2,
+                             max(4 * cfg.window_slots, 256))
+        self._fetch_all = jax.jit(jax.vmap(jax.vmap(
+            lambda log, start: fetch_window(
+                log, start, window_slots=self._replay_W))))
+        # ---- per-group host bookkeeping (mirrors SimCluster) ----
+        G, R = self.G, self.R
+        self.applied = np.zeros((G, R), np.int64)
+        self.peer_mask = np.ones((G, R, R), np.int32)
+        self.pending: List[List[list]] = [
+            [[] for _ in range(R)] for _ in range(G)]
+        self._inflight: List[List[list]] = [
+            [[] for _ in range(R)] for _ in range(G)]
+        self.replayed: List[List[list]] = [
+            [[] for _ in range(R)] for _ in range(G)]
+        self.last: Optional[Dict[str, np.ndarray]] = None
+        self.need_recovery: set = set()     # {(g, r)} force-pruned past
+        self._wedged: set = set()           # {(g, r)} frozen apply
+        self.rebases = np.zeros(G, np.int64)
+        self.rebased_total = np.zeros(G, np.int64)
+        self.rebase_stall_steps = np.zeros(G, np.int64)
+        self.rebase_stalled = np.zeros(G, np.int64)
+        self._prev_commit_max = np.zeros(G, np.int64)
+        # optional per-group chaos link models (g -> LinkModel); purely
+        # host-side input rewrites, like SimCluster.link_model
+        self.link_models: Dict[int, object] = {}
+        self.step_index = 0
+        # host-side observability facade; NEVER read inside jitted code
+        self.obs = None
+
+    # ---------------- client-side API ----------------
+
+    def submit(self, group: int, replica: int, payload: bytes,
+               etype: EntryType = EntryType.SEND, conn: int = 1,
+               req_id: int = 0) -> None:
+        """Queue a client entry for the next step on ``replica`` of
+        ``group`` (it only enters that group's log if the replica is
+        its leader — proxy semantics, per group)."""
+        self.pending[group][replica].append(
+            (int(etype), conn, req_id, payload))
+
+    def partition(self, group: int,
+                  groups_of_replicas: Sequence[Sequence[int]]) -> None:
+        """Partition ONE consensus group's replicas (other groups'
+        connectivity is untouched — per-group fault domains)."""
+        if self._fanout == "psum":
+            raise ValueError(
+                "partitions cannot be modeled with fanout='psum'; "
+                "build the cluster with fanout='gather'")
+        self.peer_mask[group, :, :] = 0
+        for grp in groups_of_replicas:
+            for i in grp:
+                for j in grp:
+                    self.peer_mask[group, i, j] = 1
+        np.fill_diagonal(self.peer_mask[group], 1)
+
+    def heal(self, group: Optional[int] = None) -> None:
+        if group is None:
+            self.peer_mask[:] = 1
+        else:
+            self.peer_mask[group, :, :] = 1
+
+    def wedge_apply(self, group: int, r: int) -> None:
+        self._wedged.add((group, r))
+
+    def unwedge_apply(self, group: int, r: int) -> None:
+        self._wedged.discard((group, r))
+
+    # ---------------- stepping ----------------
+
+    def _effective_mask(self) -> np.ndarray:
+        """[G, R, R] hear-matrix: per-group base mask refined by that
+        group's attached link model (host-side data only)."""
+        if not self.link_models:
+            return self.peer_mask
+        mask = self.peer_mask.copy()
+        for g, lm in self.link_models.items():
+            mask[g] = lm.effective_mask(mask[g], self.step_index)
+        return mask
+
+    def _norm_timeouts(self, timeouts: TimeoutsLike) -> Dict[int, list]:
+        if not timeouts:
+            return {}
+        if isinstance(timeouts, dict):
+            return {int(g): list(rs) for g, rs in timeouts.items() if rs}
+        out: Dict[int, list] = {}
+        for g, r in timeouts:
+            out.setdefault(int(g), []).append(int(r))
+        return out
+
+    def _build_inputs(self, tmo_by_group: Dict[int, list]) -> StepInput:
+        cfg, G, R = self.cfg, self.G, self.R
+        mask = self._effective_mask()
+        if self._fanout == "psum" and not mask.all():
+            raise ValueError(
+                "psum fan-out requires full connectivity; use "
+                "fanout='gather' to model partitions")
+        B = cfg.batch_slots
+        data = np.zeros((G, R, B, cfg.slot_words), np.int32)
+        meta = np.zeros((G, R, B, META_W), np.int32)
+        count = np.zeros((G, R), np.int32)
+        qdepth = np.zeros((G, R), np.int32)
+        for g in range(G):
+            for r in range(R):
+                take = self.pending[g][r][:B]
+                self.pending[g][r] = self.pending[g][r][B:]
+                self._inflight[g][r] = take
+                for i, (t, conn, req, payload) in enumerate(take):
+                    data[g, r, i] = bytes_to_words(payload,
+                                                   cfg.slot_words)
+                    meta[g, r, i, M_TYPE] = t
+                    meta[g, r, i, M_CONN] = conn
+                    meta[g, r, i, M_REQID] = req
+                    meta[g, r, i, M_LEN] = len(payload)
+                count[g, r] = len(take)
+                qdepth[g, r] = len(self.pending[g][r])
+        tmo = np.zeros((G, R), np.int32)
+        for g, rs in tmo_by_group.items():
+            for r in rs:
+                tmo[g, r] = 1
+        return StepInput(
+            batch_data=jnp.asarray(data),
+            batch_meta=jnp.asarray(meta),
+            batch_count=jnp.asarray(count),
+            timeout_fired=jnp.asarray(tmo),
+            peer_mask=jnp.asarray(mask),
+            apply_done=jnp.asarray(self.applied.astype(np.int32)),
+            queue_depth=jnp.asarray(qdepth),
+        )
+
+    def _build_step(self, *, elections: bool):
+        """Fetch (or compile once into the SHARED runtime cache) the
+        group-batched step. The cache key carries everything static
+        that shapes the program — and deliberately NOT the group count:
+        the jitted callable is batch-size-polymorphic, so every
+        homogeneous cluster shape shares one entry per variant."""
+        key = (self.cfg, self.R, "sim", self._use_pallas,
+               self._interpret, self._fanout, "group", elections)
+        cached = STEP_CACHE.get(key)
+        if cached is None:
+            cached = build_sim_group_step(
+                self.cfg, self.R, use_pallas=self._use_pallas,
+                interpret=self._interpret, fanout=self._fanout,
+                elections=elections)
+            STEP_CACHE[key] = cached
+        return cached, key
+
+    def _burst_fn(self, K: int):
+        key = (self.cfg, self.R, "sim", self._use_pallas,
+               self._interpret, self._fanout, "group-burst", K)
+        fn = STEP_CACHE.get(key)
+        if fn is None:
+            fn = build_sim_group_burst(
+                self.cfg, self.R, use_pallas=self._use_pallas,
+                interpret=self._interpret, fanout=self._fanout)
+            STEP_CACHE[key] = fn
+        return fn, key
+
+    def prewarm(self, tiers: Optional[Sequence[int]] = None) -> None:
+        """Compile every step variant (and burst tier) up front on
+        copies of the live state. One compile covers ALL groups — the
+        tiers are shared across groups by construction, and across
+        clusters through the shared runtime cache."""
+        cfg, G, R, B = self.cfg, self.G, self.R, self.cfg.batch_slots
+        inp = StepInput(
+            batch_data=jnp.zeros((G, R, B, cfg.slot_words), jnp.int32),
+            batch_meta=jnp.zeros((G, R, B, META_W), jnp.int32),
+            batch_count=jnp.zeros((G, R), jnp.int32),
+            timeout_fired=jnp.zeros((G, R), jnp.int32),
+            peer_mask=jnp.asarray(self.peer_mask),
+            apply_done=jnp.zeros((G, R), jnp.int32),
+            queue_depth=jnp.zeros((G, R), jnp.int32))
+        for elections in (True, False):
+            fn, _ = self._build_step(elections=elections)
+            st = jax.tree.map(lambda x: x.copy(), self.state)
+            fn(st, inp)
+        pm = jnp.asarray(self.peer_mask)
+        ap = jnp.zeros((G, R), jnp.int32)
+        for K in (tiers if tiers is not None else self.K_TIERS):
+            fn, _ = self._burst_fn(K)
+            st = jax.tree.map(lambda x: x.copy(), self.state)
+            fn(st, jnp.zeros((K, G, R, B, cfg.slot_words), jnp.int32),
+               jnp.zeros((K, G, R, B, META_W), jnp.int32),
+               jnp.zeros((K, G, R), jnp.int32), pm, ap,
+               jnp.zeros((G, R), jnp.int32))
+
+    def step(self, timeouts: TimeoutsLike = ()) -> Dict[str, np.ndarray]:
+        """One protocol step for EVERY group in one device dispatch.
+        ``timeouts`` fires election timers per group: a dict
+        ``{group: [replica, ...]}`` or an iterable of ``(group,
+        replica)`` pairs. Returns ``[G, R]`` result arrays."""
+        tmo = self._norm_timeouts(timeouts)
+        inp = self._build_inputs(tmo)
+        # no timer fired in ANY group ⟹ Phase B is provably a no-op
+        # for every group: dispatch the stable step (bit-identical)
+        if self._stable_fast_path and not tmo:
+            fn, key = self._build_step(elections=False)
+        else:
+            fn, key = self._step_full
+        self.state, out = fn(self.state, inp)
+        self.dispatches += 1
+        self.programs_used.add(key)
+        res = {k: np.asarray(getattr(out, k)) for k in _RES_KEYS}
+        for g in range(self.G):
+            for r in range(self.R):
+                take = self._inflight[g][r]
+                self._inflight[g][r] = []
+                if take and res["role"][g, r] == int(Role.LEADER):
+                    acc = int(res["accepted"][g, r])
+                    self._stamp_appends(g, r, take, acc, res)
+                    if acc < len(take):
+                        self.pending[g][r] = (take[acc:]
+                                              + self.pending[g][r])
+        self._replay_committed(res)
+        self._maybe_rebase(res)
+        self.last = res
+        self.step_index += 1
+        self._observe(res)
+        return res
+
+    def step_burst(self) -> Dict[str, np.ndarray]:
+        """Drain every group's pending queues through up to
+        ``max(K_TIERS)`` fused protocol steps in ONE device dispatch.
+        Same contract as ``SimCluster.step_burst`` per group: no
+        elections fire inside the burst; the caller must only burst
+        while every trafficked group has a known leader."""
+        cfg, G, R, B = self.cfg, self.G, self.R, self.cfg.batch_slots
+        assert self.last is not None, "burst requires a stepped cluster"
+        take_n = np.zeros((G, R), np.int64)
+        for g in range(G):
+            for r in range(R):
+                avail = ((cfg.n_slots - 1)
+                         - (int(self.last["end"][g, r])
+                            - int(self.last["head"][g, r])))
+                take_n[g, r] = min(len(self.pending[g][r]),
+                                   max(avail, 0), self.K_TIERS[-1] * B)
+        k_needed = max(1, int(-(-take_n.max() // B)))
+        K = next(k for k in self.K_TIERS if k >= k_needed)
+
+        data = np.zeros((K, G, R, B, cfg.slot_words), np.int32)
+        meta = np.zeros((K, G, R, B, META_W), np.int32)
+        count = np.zeros((K, G, R), np.int32)
+        qdepth = np.zeros((G, R), np.int32)
+        taken: List[List[list]] = [[[] for _ in range(R)]
+                                   for _ in range(G)]
+        for g in range(G):
+            for r in range(R):
+                n = int(take_n[g, r])
+                take = self.pending[g][r][:n]
+                self.pending[g][r] = self.pending[g][r][n:]
+                taken[g][r] = take
+                for i, (t, conn, req, payload) in enumerate(take):
+                    k, j = divmod(i, B)
+                    data[k, g, r, j] = bytes_to_words(payload,
+                                                      cfg.slot_words)
+                    meta[k, g, r, j, M_TYPE] = t
+                    meta[k, g, r, j, M_CONN] = conn
+                    meta[k, g, r, j, M_REQID] = req
+                    meta[k, g, r, j, M_LEN] = len(payload)
+                for k in range(K):
+                    count[k, g, r] = max(0, min(n - k * B, B))
+                qdepth[g, r] = len(self.pending[g][r])
+
+        mask = self._effective_mask()
+        if self._fanout == "psum" and not mask.all():
+            raise ValueError(
+                "psum fan-out requires full connectivity; use "
+                "fanout='gather' to model partitions")
+        fn, key = self._burst_fn(K)
+        self.state, outs = fn(
+            self.state, jnp.asarray(data), jnp.asarray(meta),
+            jnp.asarray(count), jnp.asarray(mask),
+            jnp.asarray(self.applied.astype(np.int32)),
+            jnp.asarray(qdepth))
+        self.dispatches += 1
+        self.programs_used.add(key)
+        res = {k: np.asarray(getattr(outs, k))[-1]
+               for k in _RES_KEYS if k != "accepted"}
+        acc = np.asarray(outs.accepted).sum(axis=0)          # [G, R]
+        res["accepted"] = acc
+        for g in range(G):
+            for r in range(R):
+                if taken[g][r] and res["role"][g, r] == int(Role.LEADER):
+                    a = int(acc[g, r])
+                    self._stamp_appends(g, r, taken[g][r], a, res)
+                    if a < len(taken[g][r]):
+                        self.pending[g][r] = (taken[g][r][a:]
+                                              + self.pending[g][r])
+        self._replay_committed(res)
+        self._maybe_rebase(res)
+        self.last = res
+        self.step_index += K
+        self._observe(res)
+        return res
+
+    # ---------------- host apply / rebase ----------------
+
+    def _replay_committed(self, res) -> None:
+        """Per-group host apply loop — ALL groups' and replicas'
+        windows ride ONE fetch dispatch per sweep (the [G, R]-vmapped
+        ``fetch_window``). Same integrity rule as ``SimCluster``: a
+        fetched entry whose stamped gidx disagrees with the expected
+        apply index means the slot was recycled past this member —
+        flag ``(g, r)`` for snapshot recovery and stop replaying."""
+        W = self._replay_W
+        while True:
+            todo = [(g, r) for g in range(self.G)
+                    for r in range(self.R)
+                    if (g, r) not in self._wedged
+                    and (g, r) not in self.need_recovery
+                    and self.applied[g, r] < int(res["commit"][g, r])]
+            if not todo:
+                return
+            starts = jnp.asarray(self.applied.astype(np.int32))
+            wd_all, wm_all = self._fetch_all(self.state.log, starts)
+            self.fetch_dispatches += 1
+            wd_all, wm_all = np.asarray(wd_all), np.asarray(wm_all)
+            for g, r in todo:
+                commit = int(res["commit"][g, r])
+                n = int(min(commit - self.applied[g, r], W))
+                wd, wm = wd_all[g, r], wm_all[g, r]
+                if n > 0 and int(wm[0, M_GIDX]) != self.applied[g, r]:
+                    self.need_recovery.add((g, r))
+                    continue
+                types = wm[:n, M_TYPE]
+                client = ((types >= int(EntryType.CONNECT))
+                          & (types <= int(EntryType.CLOSE)))
+                idxs = np.nonzero(client)[0]
+                if idxs.size:
+                    conns = wm[:n, M_CONN]
+                    reqs = wm[:n, M_REQID]
+                    lens = wm[:n, M_LEN]
+                    raw = np.ascontiguousarray(
+                        wd[:n]).view(np.uint8).reshape(n, -1)
+                    row = raw.shape[1]
+                    buf = raw.tobytes()
+                    rep = self.replayed[g][r]
+                    for j in idxs:
+                        o = int(j) * row
+                        rep.append((int(types[j]), int(conns[j]),
+                                    int(reqs[j]),
+                                    buf[o:o + int(lens[j])]))
+                self.applied[g, r] += n
+
+    def _rebase_stalled_step(self, g: int, res) -> None:
+        self.rebase_stall_steps[g] += 1
+        if self.rebase_stall_steps[g] < self.REBASE_STALL_STEPS:
+            return
+        self.rebase_stalled[g] += 1
+        if self.obs is not None:
+            from rdma_paxos_tpu.obs import trace as _trace
+            self.obs.metrics.inc("rebase_stalled", group=g)
+            if self.rebase_stall_steps[g] == self.REBASE_STALL_STEPS:
+                heads = [int(res["head"][g, r]) for r in range(self.R)]
+                self.obs.trace.record(
+                    _trace.REBASE_STALLED, group=g,
+                    end_max=int(res["end"][g].max()),
+                    threshold=self.cfg.rebase_threshold,
+                    min_head=min(heads), heads=heads,
+                    steps=int(self.rebase_stall_steps[g]))
+
+    def _maybe_rebase(self, res) -> None:
+        """Per-group coordinated i32-offset rollover: each group whose
+        max end crossed ``rebase_threshold`` drops every offset of ITS
+        replicas by its own min head (rounded down to a multiple of
+        n_slots) — other groups' offsets are untouched. All crossing
+        groups shift in one elementwise pass. ``res`` is adjusted in
+        place so callers observe post-rollover offsets."""
+        ends = res["end"].max(axis=1)                       # [G]
+        if ends.max() < self.cfg.rebase_threshold:
+            return
+        deltas = np.zeros(self.G, np.int64)
+        for g in range(self.G):
+            if ends[g] < self.cfg.rebase_threshold:
+                continue
+            heads = [int(res["head"][g, r]) for r in range(self.R)
+                     if (g, r) not in self.need_recovery]
+            if not heads:
+                self._rebase_stalled_step(g, res)
+                continue
+            delta = min(heads) & ~(self.cfg.n_slots - 1)
+            if delta <= 0:
+                self._rebase_stalled_step(g, res)
+                continue
+            deltas[g] = delta
+        if not deltas.any():
+            return
+        self._apply_rebase(deltas)
+        for g in np.nonzero(deltas)[0]:
+            d = int(deltas[g])
+            self.applied[g] -= d
+            for k in ("head", "apply", "commit", "end"):
+                res[k][g] = res[k][g] - d
+            self.rebases[g] += 1
+            self.rebased_total[g] += d
+            self.rebase_stall_steps[g] = 0
+            if self.obs is not None:
+                from rdma_paxos_tpu.obs import trace as _trace
+                self.obs.metrics.inc("rebases_total", group=int(g))
+                self.obs.metrics.inc("rebased_entries_total", d,
+                                     group=int(g))
+                self.obs.trace.record(_trace.REBASE_APPLIED,
+                                      group=int(g), delta=d,
+                                      rebases=int(self.rebases[g]))
+
+    def _apply_rebase(self, deltas: np.ndarray) -> None:
+        """Elementwise per-group offset subtraction — the grouped form
+        of ``consensus.snapshot.rebase_offsets`` (same invariants:
+        delta <= that group's min head, multiple of n_slots)."""
+        state = self.state
+        d_gr = jnp.asarray(deltas.astype(np.int32))[:, None]   # [G, 1]
+        d_buf = d_gr[:, :, None]                               # [G, 1, 1]
+        sw = state.log.slot_words
+        gcol = sw + M_GIDX
+        buf = state.log.buf.at[..., gcol].add(-d_buf)
+        self.state = dataclasses.replace(
+            state,
+            log=Log(buf=buf),
+            head=state.head - d_gr,
+            apply=state.apply - d_gr,
+            commit=state.commit - d_gr,
+            end=state.end - d_gr,
+            cfg_src=jnp.where(state.cfg_src >= 0,
+                              state.cfg_src - d_gr, state.cfg_src),
+        )
+
+    # ---------------- observability ----------------
+
+    def _span_recorder(self):
+        from rdma_paxos_tpu.obs.spans import active_recorder
+        return active_recorder(self.obs)
+
+    def _span_rep(self, g: int, r: int) -> int:
+        """Namespaced span replica id: per-group frontiers must not
+        collide in the recorder's per-replica heaps."""
+        return g * self.R + r
+
+    def _stamp_appends(self, g: int, r: int, take, acc: int,
+                       res) -> None:
+        """The accepted prefix of ``take`` landed at absolute indices
+        ``[end-acc, end)`` on group ``g``'s leader ``r`` — stamp each
+        sampled span with its ``(group, term, index)`` key."""
+        spans = self._span_recorder()
+        if spans is None or not spans.open_count or acc <= 0:
+            return
+        end_abs = int(res["end"][g, r]) + int(self.rebased_total[g])
+        term = int(res["term"][g, r])
+        replicas = [self._span_rep(g, rr) for rr in range(self.R)]
+        for i, (_t, conn, req, _p) in enumerate(take[:acc]):
+            spans.stamp_append(conn, req, term, end_abs - acc + i,
+                               self._span_rep(g, r), replicas=replicas,
+                               group=g)
+
+    def _observe(self, res) -> None:
+        """Per-group metric gauges/counters (``...{group=g}`` series)
+        plus span commit/apply frontier advance. Host-side only."""
+        spans = self._span_recorder()
+        if spans is not None and spans.open_count:
+            for g in range(self.G):
+                rebased = int(self.rebased_total[g])
+                for r in range(self.R):
+                    rep = self._span_rep(g, r)
+                    spans.commit_advance(
+                        rep, int(res["commit"][g, r]) + rebased)
+                    spans.apply_advance(
+                        rep, int(self.applied[g, r]) + rebased)
+        if self.obs is None:
+            return
+        m = self.obs.metrics
+        for g in range(self.G):
+            rebased = int(self.rebased_total[g])
+            cmax = int(res["commit"][g].max()) + rebased
+            m.set("shard_term", int(res["term"][g].max()), group=g)
+            m.set("shard_commit", cmax, group=g)
+            m.set("shard_apply",
+                  int(self.applied[g].min()) + rebased, group=g)
+            m.set("shard_leader", self.leader_hint(g), group=g)
+            delta = cmax - int(self._prev_commit_max[g])
+            if delta > 0:
+                m.inc("shard_committed_entries_total", delta, group=g)
+            self._prev_commit_max[g] = cmax
+
+    def health(self) -> dict:
+        """Aggregated sharded-cluster health: one snapshot per group
+        (per-replica offsets/roles, rebase counters, recovery flags)
+        plus the serialized ROUTER — the full routing table rides the
+        health document so any observer reconstructs the exact
+        key→group mapping without code."""
+        from rdma_paxos_tpu.obs.health import make_snapshot
+        res = self.last
+        groups = []
+        for g in range(self.G):
+            fields = dict(
+                group=g,
+                leader=self.leader_hint(g),
+                rebases=int(self.rebases[g]),
+                rebased_total=int(self.rebased_total[g]),
+                rebase_stalled=int(self.rebase_stalled[g]),
+                need_recovery=sorted(r for (gg, r) in self.need_recovery
+                                     if gg == g),
+                applied=[int(a) for a in self.applied[g]],
+            )
+            if res is not None:
+                for k in ("role", "term", "commit", "apply", "end",
+                          "head"):
+                    fields[k] = [int(v) for v in res[k][g]]
+                fields["log_headroom"] = int(
+                    self.cfg.rebase_threshold - res["end"][g].max())
+            groups.append(make_snapshot(**fields))
+        return dict(schema=1, n_groups=self.G, n_replicas=self.R,
+                    dispatches=self.dispatches,
+                    router=self.router.to_dict(), groups=groups)
+
+    # ---------------- leadership ----------------
+
+    def leader(self, group: int) -> int:
+        """Group ``group``'s leader iff exactly one replica claims it
+        (the strict ``SimCluster.leader`` rule), else -1."""
+        assert self.last is not None
+        ids = [r for r in range(self.R)
+               if self.last["role"][group, r] == int(Role.LEADER)]
+        return ids[0] if len(ids) == 1 else -1
+
+    def leader_hint(self, group: int) -> int:
+        """Highest-term self-claimed leader of ``group`` (the driver's
+        failover view rule — terms are unique per leader), or -1."""
+        if self.last is None:
+            return -1
+        claims = [(int(self.last["term"][group, r]), r)
+                  for r in range(self.R)
+                  if int(self.last["role"][group, r]) == int(Role.LEADER)]
+        return max(claims)[1] if claims else -1
+
+    def leaders(self) -> List[int]:
+        return [self.leader_hint(g) for g in range(self.G)]
+
+    def run_until_elected(self, group: int, candidate: int,
+                          max_steps: int = 5) -> int:
+        for _ in range(max_steps):
+            res = self.step(timeouts={group: [candidate]})
+            if res["role"][group, candidate] == int(Role.LEADER):
+                return candidate
+        raise AssertionError(
+            f"election did not converge in group {group}")
+
+    def place_leaders(self, policy: str = "round_robin",
+                      max_steps: int = 12) -> List[int]:
+        """Elect a leader in EVERY group, spread across the R replicas
+        so the G leaderships don't pile onto replica 0.
+
+        * ``round_robin`` — group g targets replica ``g % R``.
+        * ``least_loaded`` — each group targets the replica currently
+          holding the fewest leaderships (existing leaders counted
+          first, then assignments made greedily in group order).
+
+        Elections for different groups ride the SAME dispatches — the
+        whole placement typically converges in one or two steps.
+        Returns the per-group target list."""
+        if policy == "round_robin":
+            targets = [g % self.R for g in range(self.G)]
+        elif policy == "least_loaded":
+            load = [0] * self.R
+            targets = [-1] * self.G
+            for g in range(self.G):
+                cur = self.leader_hint(g) if self.last is not None else -1
+                if cur >= 0:
+                    targets[g] = cur
+                    load[cur] += 1
+            for g in range(self.G):
+                if targets[g] < 0:
+                    t = int(np.argmin(load))
+                    targets[g] = t
+                    load[t] += 1
+        else:
+            raise ValueError(f"unknown placement policy: {policy!r}")
+        for _ in range(max_steps):
+            pending = {g: [targets[g]] for g in range(self.G)
+                       if self.last is None
+                       or self.leader(g) != targets[g]}
+            if not pending:
+                return targets
+            self.step(timeouts=pending)
+        undone = [g for g in range(self.G)
+                  if self.leader(g) != targets[g]]
+        if undone:
+            raise AssertionError(
+                f"leader placement did not converge for groups {undone}")
+        return targets

@@ -1,0 +1,195 @@
+"""Deterministic key→group routing for the sharded multi-group cluster.
+
+The reference scales by running one consensus group per application;
+the sharded layer partitions ONE application's keyspace across many
+independent groups instead (the way reconfigurable commit protocols
+shard state across replica groups — PAPERS.md, arXiv:1906.01365). The
+router is the contract every client, proxy, and operator tool must
+agree on, so it is built from primitives that are stable across
+process restarts, machines, and Python versions:
+
+* a **hash ring**: each of the ``n_groups`` groups owns ``vnodes``
+  points on a 32-bit ring, placed by :func:`ring_hash` (FNV-1a mixed
+  through the Murmur3 finalizer — never Python's salted ``hash()``)
+  over a canonical label; a key routes to the successor point of its
+  own :func:`ring_hash`. Fixed group count — group split/merge
+  reconfiguration is a ROADMAP follow-on, not this layer.
+* an explicit **range-override table**: ordered ``(lo, hi, group)``
+  rules on raw key bytes (``lo <= key < hi``, lexicographic;
+  ``hi=None`` = unbounded). First matching rule wins and overrides
+  take precedence over the ring — the operator's escape hatch for hot
+  ranges, locality pinning, and migration staging.
+
+Keys are raw bytes; ``str`` keys are accepted and canonicalized as
+UTF-8. The empty key is a valid key (it hashes to the FNV offset
+basis). The full routing table serializes to a plain dict
+(:meth:`KeyRouter.to_dict`) that rides the sharded cluster's health
+snapshots, so any observer can reconstruct the exact mapping without
+importing this module's code — and ``tests/golden/router_map.json``
+pins the mapping across releases.
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import List, Optional, Sequence, Tuple, Union
+
+KeyLike = Union[bytes, bytearray, str]
+
+_FNV_OFFSET = 0x811C9DC5
+_FNV_PRIME = 0x01000193
+
+
+def fnv1a32(data: bytes) -> int:
+    """32-bit FNV-1a — stable by construction (pure arithmetic over
+    bytes), unlike Python's per-process-salted ``hash``; golden-file
+    tested across restarts."""
+    h = _FNV_OFFSET
+    for b in data:
+        h = ((h ^ b) * _FNV_PRIME) & 0xFFFFFFFF
+    return h
+
+
+def _fmix32(h: int) -> int:
+    """Murmur3's 32-bit finalizer. Raw FNV-1a has weak avalanche in
+    the high bits — sequential keys (``k0``, ``k1``, ...) cluster on
+    the ring and skew group load badly; one finalizer round spreads
+    them. Pure arithmetic, restart-stable."""
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & 0xFFFFFFFF
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & 0xFFFFFFFF
+    h ^= h >> 16
+    return h
+
+
+def ring_hash(data: bytes) -> int:
+    """The router's placement hash: FNV-1a mixed through the Murmur3
+    finalizer — used for both ring points and keys."""
+    return _fmix32(fnv1a32(data))
+
+
+def canon_key(key: KeyLike) -> bytes:
+    """Canonical key bytes: bytes pass through, ``str`` encodes UTF-8.
+    The empty key is legal (it routes like any other)."""
+    if isinstance(key, str):
+        return key.encode("utf-8")
+    if isinstance(key, (bytes, bytearray)):
+        return bytes(key)
+    raise TypeError(f"key must be bytes or str, not {type(key).__name__}")
+
+
+class RangeRule:
+    """One override: keys in ``[lo, hi)`` (byte-lexicographic; ``hi``
+    ``None`` = +inf) route to ``group``, bypassing the ring."""
+
+    __slots__ = ("lo", "hi", "group")
+
+    def __init__(self, lo: KeyLike, hi: Optional[KeyLike], group: int):
+        self.lo = canon_key(lo)
+        self.hi = canon_key(hi) if hi is not None else None
+        self.group = int(group)
+        if self.hi is not None and self.hi <= self.lo:
+            raise ValueError(f"empty range: lo={self.lo!r} hi={self.hi!r}")
+
+    def matches(self, key: bytes) -> bool:
+        return key >= self.lo and (self.hi is None or key < self.hi)
+
+    def to_dict(self) -> dict:
+        return dict(lo=self.lo.hex(),
+                    hi=self.hi.hex() if self.hi is not None else None,
+                    group=self.group)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "RangeRule":
+        return cls(bytes.fromhex(d["lo"]),
+                   bytes.fromhex(d["hi"]) if d["hi"] is not None else None,
+                   d["group"])
+
+
+class KeyRouter:
+    """Hash-ring + range-override key→group mapping (see module doc).
+
+    Deterministic and stateless after construction: ``group_of`` is a
+    pure function of (key, n_groups, vnodes, overrides).
+    """
+
+    def __init__(self, n_groups: int, *, vnodes: int = 64,
+                 overrides: Sequence[Union[RangeRule, tuple]] = ()):
+        if n_groups < 1:
+            raise ValueError("n_groups must be >= 1")
+        if vnodes < 1:
+            raise ValueError("vnodes must be >= 1")
+        self.n_groups = int(n_groups)
+        self.vnodes = int(vnodes)
+        self.overrides: List[RangeRule] = [
+            r if isinstance(r, RangeRule) else RangeRule(*r)
+            for r in overrides]
+        for r in self.overrides:
+            if not (0 <= r.group < self.n_groups):
+                raise ValueError(
+                    f"override group {r.group} out of range "
+                    f"[0, {self.n_groups})")
+        # ring points: FNV-1a of a canonical label per (group, vnode).
+        # A 32-bit collision between two groups' points is resolved by
+        # the (point, group) sort order — deterministically, the lower
+        # group id wins the shared point.
+        ring: List[Tuple[int, int]] = []
+        for g in range(self.n_groups):
+            for v in range(self.vnodes):
+                ring.append((ring_hash(b"group:%d:vnode:%d" % (g, v)), g))
+        ring.sort()
+        self._ring = ring
+        self._points = [p for p, _ in ring]
+
+    # ---------------- routing ----------------
+
+    def group_of(self, key: KeyLike) -> int:
+        """The group serving ``key``: first matching range override,
+        else the ring successor of the key's hash (wrapping)."""
+        kb = canon_key(key)
+        for rule in self.overrides:
+            if rule.matches(kb):
+                return rule.group
+        h = ring_hash(kb)
+        i = bisect.bisect_left(self._points, h)
+        if i == len(self._points):
+            i = 0                           # wrap to the ring start
+        return self._ring[i][1]
+
+    # ---------------- serialization (health snapshots) ----------------
+
+    def to_dict(self) -> dict:
+        """Plain-data routing table for health snapshots and golden
+        files: everything needed to reconstruct the mapping, plus a
+        ring checksum so observers can verify agreement without
+        rebuilding the ring."""
+        ck = _FNV_OFFSET
+        for p, g in self._ring:
+            for b in p.to_bytes(4, "big") + bytes([g & 0xFF]):
+                ck = ((ck ^ b) * _FNV_PRIME) & 0xFFFFFFFF
+        return dict(schema=1, kind="hash_ring", n_groups=self.n_groups,
+                    vnodes=self.vnodes, hash="fnv1a32+fmix32",
+                    ring_checksum=ck,
+                    overrides=[r.to_dict() for r in self.overrides])
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "KeyRouter":
+        if (d.get("kind") != "hash_ring"
+                or d.get("hash") != "fnv1a32+fmix32"):
+            raise ValueError(f"unknown router serialization: {d!r}")
+        router = cls(d["n_groups"], vnodes=d["vnodes"],
+                     overrides=[RangeRule.from_dict(o)
+                                for o in d["overrides"]])
+        want = d.get("ring_checksum")
+        have = router.to_dict()["ring_checksum"]
+        if want is not None and want != have:
+            raise ValueError(
+                f"router ring checksum mismatch: snapshot {want} != "
+                f"rebuilt {have} (incompatible router versions?)")
+        return router
+
+    def __repr__(self) -> str:
+        return (f"KeyRouter(n_groups={self.n_groups}, "
+                f"vnodes={self.vnodes}, "
+                f"overrides={len(self.overrides)})")
