@@ -28,6 +28,57 @@ NATIVE = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "native")
 
 
+def measure_audit_overhead(cfg=None, *, n_replicas=3, steps=300,
+                           per_step=8, payload=64, warmup=10,
+                           repeats=3):
+    """A/B the compiled-step digest chain: drive the identical
+    closed-loop workload through an audit-off and an audit-on
+    ``SimCluster`` and compare committed-entry throughput. The two
+    variants run ALTERNATING for ``repeats`` rounds and each variant
+    scores its fastest round (host-load noise on a shared machine
+    easily exceeds the effect being measured). Returns
+    ``{"off": {...}, "on": {...}, "overhead_pct": ...}`` (the <5%
+    acceptance target for the ``--audit`` bench row)."""
+    import time as _t
+
+    from rdma_paxos_tpu.config import LogConfig
+    from rdma_paxos_tpu.runtime.sim import SimCluster
+
+    if cfg is None:
+        cfg = LogConfig(n_slots=512, slot_bytes=128, window_slots=64,
+                        batch_slots=16)
+    blob = b"x" * payload
+    clusters = {}
+    for variant in ("off", "on"):
+        c = SimCluster(cfg, n_replicas, fanout="psum",
+                       audit=(variant == "on"))
+        c.run_until_elected(0)
+        for _ in range(warmup):
+            c.submit(0, blob)
+            c.step()
+        clusters[variant] = c
+    out = {v: dict(steps=steps, seconds=None, committed=None,
+                   ops_per_sec=0.0) for v in clusters}
+    for _ in range(repeats):
+        for variant, c in clusters.items():
+            base = int(c.last["commit"].max()) + c.rebased_total
+            t0 = _t.perf_counter()
+            for _ in range(steps):
+                for _ in range(per_step):
+                    c.submit(0, blob)
+                c.step()
+            dt = _t.perf_counter() - t0
+            done = int(c.last["commit"].max()) + c.rebased_total - base
+            ops = round(done / dt, 1)
+            if ops > out[variant]["ops_per_sec"]:
+                out[variant] = dict(steps=steps, seconds=round(dt, 4),
+                                    committed=done, ops_per_sec=ops)
+    out["audit"] = clusters["on"].auditor.summary()
+    off, on = out["off"]["ops_per_sec"], out["on"]["ops_per_sec"]
+    out["overhead_pct"] = round((off - on) / off * 100, 2)
+    return out
+
+
 def client_worker(port, n, lat, tid, pipeline=1, retries=5):
     """Pipelined client (the redis-benchmark -P analog): P commands per
     write — the app's read() picks them up as ONE buffer, so they ride a
@@ -101,6 +152,12 @@ def main():
                          "so step-phase histograms attribute device-sync "
                          "time separately from dispatch (profiling mode; "
                          "serializes the dispatch pipeline)")
+    ap.add_argument("--audit", action="store_true",
+                    help="silent-divergence auditing: compile the "
+                         "digest-chain step variants, run the cluster "
+                         "audit ledger + flight recorder + SLO alerts "
+                         "during the workload, and emit an "
+                         "audit-overhead A/B row (digests on vs off)")
     args = ap.parse_args()
 
     if args.groups:
@@ -110,6 +167,7 @@ def main():
         # loudly rather than silently dropping an explicit request.
         dropped = [flag for flag, on in (
             ("--trace", args.trace), ("--fence", args.fence),
+            ("--audit", args.audit),
             ("--trace-json", args.trace_json),
             ("--metrics-json", args.metrics_json),
             ("--threaded-app", args.threaded_app)) if on]
@@ -147,7 +205,7 @@ def main():
         cfg, args.replicas, workdir=wd, app_ports=ports,
         timeout_cfg=TimeoutConfig(elec_timeout_low=0.5,
                                   elec_timeout_high=1.0),
-        fanout="psum", fence=args.fence)
+        fanout="psum", fence=args.fence, audit=args.audit)
     if args.trace:
         # 100% sampling (the default is rate-limited); capacity sized
         # so a full run's spans are retained for the export
@@ -275,9 +333,26 @@ def main():
                      if nb else None),
              p99_ms=(round(lat[int(nb * .99)] * 1e3, 2)
                      if nb else None),
-             fence=bool(args.fence), trace=trace_detail,
+             fence=bool(args.fence), audit=bool(args.audit),
+             trace=trace_detail,
              health=health),
          obs=driver.obs, json_path=args.json)
+
+    if args.audit:
+        # e2e audit verdict (the whole workload ran digest-checked)
+        # plus the A/B overhead row the acceptance criteria ask for
+        summary = health.get("audit") or {}
+        print(f"audit: {summary.get('indices_checked', 0)} index "
+              f"checks over {summary.get('windows', 0)} windows, "
+              f"{summary.get('findings', 0)} divergence finding(s)")
+        ab = measure_audit_overhead()
+        print(f"audit overhead: {ab['off']['ops_per_sec']} ops/s off "
+              f"vs {ab['on']['ops_per_sec']} ops/s on "
+              f"({ab['overhead_pct']}% — target <5%)")
+        emit("audit_overhead_pct", ab["overhead_pct"], "%",
+             detail=dict(off=ab["off"], on=ab["on"],
+                         audit=ab["audit"], e2e_audit=summary),
+             obs=driver.obs, json_path=args.json)
 
     # replication check on one follower
     fol = next(r for r in range(args.replicas) if r != lead)

@@ -209,7 +209,8 @@ class NemesisRunner:
                  settle_steps: int = 30,
                  artifact_path: Optional[str] = None,
                  skip_incompatible_faults: bool = False,
-                 obs: Optional[Observability] = None):
+                 obs: Optional[Observability] = None,
+                 audit: bool = True):
         self.cfg = cfg or DEFAULT_KV_CFG
         self.R = int(n_replicas)
         self.seed = int(seed)
@@ -244,7 +245,13 @@ class NemesisRunner:
                 "fault(s) (partition/crash/drop/delay need 'gather')",
                 n_dropped)
         self.schedule = schedule
-        self.cluster = SimCluster(self.cfg, self.R, fanout=fanout)
+        # chaos runs audit at 100% by default: every committed entry is
+        # digest-checked across replicas every step, so a run that
+        # passes also PROVES bit-identical replicated state under the
+        # schedule (and a divergence ships audit + flight evidence in
+        # the reproducer artifact)
+        self.cluster = SimCluster(self.cfg, self.R, fanout=fanout,
+                                  audit=audit)
         self.cluster.obs = self.obs
         self.link = LinkModel(self.R, seed=seed)
         self.link.obs = self.obs
@@ -331,7 +338,11 @@ class NemesisRunner:
             except InvariantViolation as v:
                 violations.append(v.as_dict())
         linz = check_history(self.history.ops())
-        ok = not violations and linz["ok"] is True
+        audit_summary = (self.cluster.auditor.summary()
+                         if self.cluster.auditor is not None else None)
+        audit_ok = (audit_summary is None
+                    or audit_summary["findings"] == 0)
+        ok = not violations and linz["ok"] is True and audit_ok
         verdict: Dict = dict(
             ok=ok, seed=self.seed, steps=self.steps,
             schedule_events=len(self.schedule),
@@ -341,6 +352,7 @@ class NemesisRunner:
                                  undecided=linz["undecided"],
                                  ops=linz["ops"],
                                  states=linz["states"]),
+            audit=audit_summary,
             history_events=len(self.history),
             client_ops=len(self.history.ops(include_weak=True)),
         )
@@ -351,6 +363,7 @@ class NemesisRunner:
             reason = ("invariant violation" if violations
                       else "linearizability violation"
                       if linz["violations"]
+                      else "audit divergence" if not audit_ok
                       else "linearizability undecided "
                            "(checker state budget exceeded)")
             verdict["artifact"] = chaos_artifact.write_reproducer(
@@ -361,10 +374,20 @@ class NemesisRunner:
                 violation=dict(invariants=violations,
                                linearizability={
                                    "violations": linz["violations"],
-                                   "undecided": linz["undecided"]}),
-                obs=self.obs, extra={"verdict": {
-                    k: v for k, v in verdict.items()
-                    if k != "artifact"}})
+                                   "undecided": linz["undecided"]},
+                               audit=audit_summary),
+                obs=self.obs, extra={
+                    "verdict": {k: v for k, v in verdict.items()
+                                if k != "artifact"},
+                    # the audit ledger dump + flight-recorder ring ride
+                    # every reproducer so a divergence is localizable
+                    # (and the seeded run replayable) from the artifact
+                    "audit": (self.cluster.auditor.dump()
+                              if self.cluster.auditor is not None
+                              else None),
+                    "flight": (self.cluster.flight.dump()
+                               if self.cluster.flight is not None
+                               else None)})
         return verdict
 
     # ------------------------------------------------------------------

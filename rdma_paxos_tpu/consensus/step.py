@@ -59,7 +59,7 @@ dominant leader's row.
 from __future__ import annotations
 
 import dataclasses
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -146,6 +146,13 @@ class StepOutput:
                               # NodeDaemon applies it collectively; the
                               # in-process drivers use their omniscient
                               # min-head instead (partition-safe).
+    # --- correctness-observability digest chain (audit=True only) ---
+    # None in the default program: None leaves add no pytree nodes, so
+    # the audit=False step is BYTE-IDENTICAL to the pre-audit program
+    # (cache-key guarded by tests/test_audit.py).
+    audit_start: Optional[jax.Array] = None    # i32 — first digested index
+    audit_digest: Optional[jax.Array] = None   # [W] u32 — per-entry digests
+    audit_term: Optional[jax.Array] = None     # [W] i32 — per-entry terms
 
 
 def make_step_input(cfg: LogConfig, n_replicas: int) -> StepInput:
@@ -189,6 +196,7 @@ def replica_step(
     interpret: bool = False,
     fanout: str = "gather",
     elections: bool = True,
+    audit: bool = False,
 ) -> Tuple[ReplicaState, StepOutput]:
     """One protocol step for this replica (call under ``shard_map`` over the
     ``replica`` mesh axis, or under ``vmap(axis_name=...)`` for single-chip
@@ -226,6 +234,17 @@ def replica_step(
     the full step otherwise. Term adoption from the control gather and
     window absorption still run, so a deposed leader steps down and a
     higher-term leader is followed even in stable steps.
+
+    ``audit=True`` compiles the silent-divergence digest chain: one
+    u32 checksum per committed entry in the window ``[commit - W,
+    commit)``, emitted as extra ``StepOutput`` fields (see the audit
+    block below and the host-side ledger in ``obs/audit.py``; nothing
+    from that host layer is ever called here). The followers of
+    this design are passive in the replication hot path — one-sided
+    window absorption lands bytes in log memory with no receiver-side
+    end-to-end check — so bit corruption of replicated state is silent
+    without it. ``audit=False`` (the default) is byte-identical to the
+    pre-audit program.
     """
     assert fanout in ("gather", "psum"), fanout
     i32 = jnp.int32
@@ -693,6 +712,50 @@ def replica_step(
     ccfg_cid2 = jnp.where(promote, cid2, cc1_cid)
     ccfg_epoch2 = jnp.where(promote, epoch2, cc1_epoch)
 
+    # ------------------------------------------------------------------
+    # Silent-divergence audit digests (audit=True only; statically
+    # removed otherwise — the default program stays byte-identical).
+    # One digest per entry in the window [commit2 - W, commit2): commit
+    # advances at most W per step (the leader scans a W-entry window;
+    # the follower advance is clamped to W), so consecutive windows
+    # tile the committed prefix with NO gaps, and each entry is
+    # RE-digested on every step while commit2 <= g + W — the host
+    # ledger (obs/audit.py) both cross-checks replicas at matching
+    # absolute indices and re-checks a replica's own earlier reports,
+    # catching post-commit bit corruption of log memory. The mul-fold
+    # covers the fused slot row (payload words + metadata incl. the
+    # term column — the HardState binding) EXCEPT the M_GIDX column:
+    # the coordinated i32 rollover rewrites gidx in place, and a
+    # digest covering it would tear between replicas that digest the
+    # same entry on opposite sides of a rollover; position binding
+    # comes from the ledger's absolute index instead. Entries below
+    # ``head`` are masked out (their slots may be recycled), which is
+    # safe: g >= head implies the slot physically holds entry g (the
+    # ring retains at most n_slots - 1 live entries).
+    audit_start = audit_digest = audit_terms = None
+    if audit:
+        u32 = jnp.uint32
+        a_g = (commit2 - W) + jnp.arange(W, dtype=i32)
+        audit_start = jnp.maximum(jnp.maximum(commit2 - W, head2), 0)
+        a_valid = a_g >= audit_start
+        a_rows = log3.buf[slot_of(a_g, cfg.n_slots)].astype(u32)
+        prime = u32(0x01000193)                   # FNV-1a prime
+        acc = jnp.full((W,), 0x811C9DC5, u32)     # FNV offset basis
+        gidx_col = cfg.slot_words + M_GIDX
+        for c in range(cfg.slot_words + META_W):
+            if c == gidx_col:
+                continue
+            acc = acc * prime + a_rows[:, c]
+        # murmur3-style finalizer so a low-order flip diffuses
+        acc = acc ^ (acc >> 15)
+        acc = acc * u32(0x2C1B3C6D)
+        acc = acc ^ (acc >> 12)
+        acc = acc * u32(0x297A2D39)
+        acc = acc ^ (acc >> 15)
+        audit_digest = jnp.where(a_valid, acc, u32(0))
+        audit_terms = jnp.where(
+            a_valid, a_rows[:, cfg.slot_words + M_TERM].astype(i32), 0)
+
     new_state = ReplicaState(
         log=log3, term=new_term2, role=role2, leader_id=leader_id2,
         voted_term=new_voted_term, voted_for=new_voted_for,
@@ -743,6 +806,9 @@ def replica_step(
                     ~(cfg.n_slots - 1)),
                 0),
             0).astype(i32),
+        audit_start=audit_start,
+        audit_digest=audit_digest,
+        audit_term=audit_terms,
     )
     return new_state, out
 
@@ -756,6 +822,7 @@ def group_step(
     interpret: bool = False,
     fanout: str = "gather",
     elections: bool = True,
+    audit: bool = False,
 ):
     """The group-batched protocol step: G independent consensus groups
     advanced by ONE program.
@@ -787,7 +854,8 @@ def group_step(
     core = functools.partial(
         replica_step, cfg=cfg, n_replicas=n_replicas,
         axis_name=axis_name, use_pallas=use_pallas,
-        interpret=interpret, fanout=fanout, elections=elections)
+        interpret=interpret, fanout=fanout, elections=elections,
+        audit=audit)
     vstep = jax.vmap(core, in_axes=(0, 0), axis_name=axis_name)
     return jax.vmap(vstep, in_axes=(0, 0))
 

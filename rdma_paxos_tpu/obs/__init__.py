@@ -21,6 +21,13 @@ Three parts, all host-side, all zero-dependency (stdlib only):
 * :mod:`~rdma_paxos_tpu.obs.clock` — the shared ``(monotonic, wall)``
   anchor pair every dump is stamped with, so trace/health/span
   exports from different processes align on one timebase.
+* :mod:`~rdma_paxos_tpu.obs.audit` — silent-divergence auditing: the
+  cluster audit ledger over the on-device digest chain (``audit=True``
+  compiled steps), the flight recorder, audit artifacts, and the
+  first-divergence merge CLI.
+* :mod:`~rdma_paxos_tpu.obs.alerts` — declarative SLO alert rules
+  (digest mismatch = page, leaderless, commit-latency p99, rebase
+  stalls) evaluated by the driver/daemon host loops.
 
 HARD RULE: no metrics/trace call may execute inside a
 jitted/``shard_map``ped function — instrumentation lives in the host
@@ -33,7 +40,10 @@ from __future__ import annotations
 
 from typing import Optional
 
-from rdma_paxos_tpu.obs import clock, health, metrics, spans, trace
+from rdma_paxos_tpu.obs import (
+    alerts, audit, clock, health, metrics, spans, trace)
+from rdma_paxos_tpu.obs.alerts import AlertEngine
+from rdma_paxos_tpu.obs.audit import AuditLedger, FlightRecorder
 from rdma_paxos_tpu.obs.health import HealthReporter
 from rdma_paxos_tpu.obs.metrics import MetricsRegistry
 from rdma_paxos_tpu.obs.spans import SpanRecorder, StepPhaseProfiler
@@ -87,4 +97,6 @@ def default() -> Observability:
 
 __all__ = ["Observability", "MetricsRegistry", "TraceRing",
            "HealthReporter", "SpanRecorder", "StepPhaseProfiler",
-           "default", "metrics", "trace", "health", "spans", "clock"]
+           "AuditLedger", "FlightRecorder", "AlertEngine",
+           "default", "metrics", "trace", "health", "spans", "clock",
+           "audit", "alerts"]

@@ -1,0 +1,265 @@
+"""SLO alerting — a small declarative rule engine over the metrics
+registry.
+
+The registry (obs/metrics.py) answers "what is the value"; nothing
+before this module answers "should someone be paged". Rules are plain
+dicts (JSON-serializable — they ride health snapshots verbatim), each
+naming a metric, an evaluation ``kind``, a threshold, a ``severity``
+(``page`` | ``warn``) and an optional ``for_evals`` hysteresis (the
+condition must hold for N consecutive evaluations before the alert
+fires — transient blips don't page). The engine is evaluated from the
+driver/daemon host loops on a cadence; it never runs inside jitted
+code and never blocks the data path.
+
+Rule kinds:
+
+* ``counter_nonzero`` — fires while the summed counter is > 0 (a
+  latched condition: digest divergence never un-happens).
+* ``counter_rate`` — fires when the counter's delta since the previous
+  evaluation exceeds ``threshold`` (e.g. ``rebase_stalled`` ticking).
+* ``gauge_cmp`` — compares a gauge against ``value`` with ``op`` in
+  ``< > == != <= >=`` (e.g. ``cluster_leader == -1`` = leaderless).
+* ``hist_quantile`` — estimates quantile ``q`` from the fixed-bucket
+  histogram (bucket upper bound containing the q-th observation;
+  series with the same name are merged — same ladder by design) and
+  compares it against ``threshold`` with ``op``.
+
+Metric matching aggregates across label sets by default (counters are
+summed, gauges take the configured ``agg`` — max by default);
+``labels={...}`` restricts a rule to exact label pairs.
+
+Firing state is exported two ways: ``alert_firing{alert=<name>}``
+gauges in the registry (scrapable like any other series) and
+:meth:`AlertEngine.state` (embedded in health snapshots). Transitions
+emit ``alert_fired`` / ``alert_resolved`` trace events when a trace
+ring is attached.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+PAGE = "page"
+WARN = "warn"
+
+KINDS = ("counter_nonzero", "counter_rate", "gauge_cmp",
+         "hist_quantile")
+
+_OPS = {
+    "<": lambda a, b: a < b,
+    ">": lambda a, b: a > b,
+    "<=": lambda a, b: a <= b,
+    ">=": lambda a, b: a >= b,
+    "==": lambda a, b: a == b,
+    "!=": lambda a, b: a != b,
+}
+
+
+def default_rules(*, commit_p99_ceiling_s: float = 0.5,
+                  leaderless_evals: int = 5) -> List[dict]:
+    """The stock SLO rule set: digest mismatch pages immediately (a
+    correctness violation, not a performance blip); sustained
+    leaderlessness pages; commit-latency p99 above the ceiling and a
+    ticking rebase stall warn."""
+    return [
+        dict(name="digest_divergence", severity=PAGE,
+             kind="counter_nonzero", metric="audit_divergence_total"),
+        dict(name="leaderless", severity=PAGE, kind="gauge_cmp",
+             metric="cluster_leader", op="==", value=-1,
+             for_evals=leaderless_evals),
+        dict(name="commit_latency_p99", severity=WARN,
+             kind="hist_quantile", metric="commit_latency_seconds",
+             q=0.99, op=">", threshold=commit_p99_ceiling_s,
+             for_evals=2),
+        dict(name="rebase_stalled", severity=WARN, kind="counter_rate",
+             metric="rebase_stalled", threshold=0),
+    ]
+
+
+def _split_key(key: str) -> Tuple[str, Dict[str, str]]:
+    base, sep, rest = key.partition("{")
+    if not sep:
+        return base, {}
+    pairs = {}
+    for part in rest.rstrip("}").split(","):
+        if part:
+            k, _, v = part.partition("=")
+            pairs[k] = v
+    return base, pairs
+
+
+def _match(section: dict, metric: str,
+           labels: Optional[dict]) -> List:
+    out = []
+    for key, val in section.items():
+        base, pairs = _split_key(key)
+        if base != metric:
+            continue
+        if labels and any(pairs.get(k) != str(v)
+                          for k, v in labels.items()):
+            continue
+        out.append(val)
+    return out
+
+
+def _quantile(hists: Sequence[dict], q: float) -> Optional[float]:
+    """Upper bound of the bucket containing the q-th observation across
+    merged fixed-bucket histograms (same ladder by design)."""
+    total = sum(h["count"] for h in hists)
+    if total == 0:
+        return None
+    merged: Dict[str, int] = {}
+    for h in hists:
+        for bound, c in h["buckets"].items():
+            merged[bound] = merged.get(bound, 0) + c
+    finite = sorted(((float(b), c) for b, c in merged.items()
+                     if b != "+Inf"))
+    need = q * total
+    cum = 0
+    for bound, c in finite:
+        cum += c
+        if cum >= need:
+            return bound
+    return float("inf")
+
+
+class AlertEngine:
+    """Evaluates a declarative rule list against registry snapshots,
+    with per-rule hysteresis and firing-state export."""
+
+    def __init__(self, registry, rules: Optional[Sequence[dict]] = None,
+                 *, trace=None):
+        self.registry = registry
+        self.trace = trace
+        self.rules = [dict(r) for r in (rules if rules is not None
+                                        else default_rules())]
+        seen = set()
+        for r in self.rules:
+            if "name" not in r or "metric" not in r:
+                raise ValueError(f"rule missing name/metric: {r}")
+            if r.get("kind") not in KINDS:
+                raise ValueError(
+                    f"rule {r['name']!r}: unknown kind {r.get('kind')!r}"
+                    f" (known: {KINDS})")
+            if r["name"] in seen:
+                raise ValueError(f"duplicate rule name {r['name']!r}")
+            seen.add(r["name"])
+            # kind-specific completeness is checked HERE, not at
+            # evaluation time: the engine runs inside the driver poll
+            # loop, where a KeyError would be a fatal step crash that
+            # fails every inflight commit — construction is the only
+            # place a bad rule may raise
+            kind = r["kind"]
+            if kind == "gauge_cmp":
+                if r.get("op") not in _OPS or "value" not in r:
+                    raise ValueError(
+                        f"rule {r['name']!r}: gauge_cmp needs op in "
+                        f"{sorted(_OPS)} and a value")
+            elif kind == "hist_quantile":
+                if "threshold" not in r:
+                    raise ValueError(
+                        f"rule {r['name']!r}: hist_quantile needs a "
+                        "threshold")
+                if r.get("op", ">") not in _OPS:
+                    raise ValueError(
+                        f"rule {r['name']!r}: bad op {r.get('op')!r}")
+        self._lock = threading.Lock()
+        self._st: Dict[str, dict] = {
+            r["name"]: dict(severity=r.get("severity", WARN),
+                            firing=False, pending=0, value=None,
+                            since_eval=None, fired_count=0)
+            for r in self.rules}
+        self._prev_counter: Dict[str, float] = {}
+        self.evals = 0
+
+    # ---------------- evaluation ----------------
+
+    def _eval_rule(self, rule: dict, snap: dict):
+        kind = rule["kind"]
+        metric, labels = rule["metric"], rule.get("labels")
+        if kind == "counter_nonzero":
+            total = sum(_match(snap["counters"], metric, labels))
+            return total, total > 0
+        if kind == "counter_rate":
+            total = sum(_match(snap["counters"], metric, labels))
+            prev = self._prev_counter.get(rule["name"])
+            self._prev_counter[rule["name"]] = total
+            if prev is None:
+                return 0, False      # first sighting: establish baseline
+            delta = total - prev
+            return delta, delta > rule.get("threshold", 0)
+        if kind == "gauge_cmp":
+            vals = _match(snap["gauges"], metric, labels)
+            if not vals:
+                return None, False
+            agg = rule.get("agg", "max")
+            value = (min(vals) if agg == "min" else
+                     max(vals) if agg == "max" else vals[0])
+            return value, _OPS[rule["op"]](value, rule["value"])
+        if kind == "hist_quantile":
+            hists = _match(snap["histograms"], metric, labels)
+            value = _quantile(hists, rule.get("q", 0.99)) \
+                if hists else None
+            if value is None:
+                return None, False
+            return value, _OPS[rule.get("op", ">")](value,
+                                                    rule["threshold"])
+        raise AssertionError(kind)
+
+    def evaluate(self) -> Dict[str, List[str]]:
+        """One evaluation pass; returns the transitions
+        ``{"fired": [...], "resolved": [...]}``. Firing gauges
+        (``alert_firing{alert=name}``) are refreshed every pass."""
+        snap = self.registry.snapshot()
+        fired: List[str] = []
+        resolved: List[str] = []
+        with self._lock:
+            self.evals += 1
+            for rule in self.rules:
+                value, cond = self._eval_rule(rule, snap)
+                st = self._st[rule["name"]]
+                st["value"] = value
+                if cond:
+                    st["pending"] += 1
+                    if (not st["firing"]
+                            and st["pending"]
+                            >= int(rule.get("for_evals", 1))):
+                        st["firing"] = True
+                        st["since_eval"] = self.evals
+                        st["fired_count"] += 1
+                        fired.append(rule["name"])
+                else:
+                    st["pending"] = 0
+                    if st["firing"]:
+                        st["firing"] = False
+                        st["since_eval"] = None
+                        resolved.append(rule["name"])
+                self.registry.set("alert_firing",
+                                  1 if st["firing"] else 0,
+                                  alert=rule["name"])
+        if self.trace is not None:
+            from rdma_paxos_tpu.obs import trace as _trace
+            for n in fired:
+                self.trace.record(_trace.ALERT_FIRED, alert=n,
+                                  severity=self._st[n]["severity"],
+                                  value=self._st[n]["value"])
+            for n in resolved:
+                self.trace.record(_trace.ALERT_RESOLVED, alert=n)
+        return dict(fired=fired, resolved=resolved)
+
+    # ---------------- state export ----------------
+
+    def severity(self, name: str) -> str:
+        return self._st[name]["severity"]
+
+    def firing(self, severity: Optional[str] = None) -> List[str]:
+        with self._lock:
+            return [n for n, st in self._st.items()
+                    if st["firing"]
+                    and (severity is None or st["severity"] == severity)]
+
+    def state(self) -> dict:
+        """Per-rule firing state for health snapshots (plain data)."""
+        with self._lock:
+            return {n: dict(st) for n, st in self._st.items()}

@@ -1,0 +1,482 @@
+"""Silent-divergence auditing — cluster audit ledger, flight recorder,
+audit artifacts, and the first-divergence CLI.
+
+APUS's followers are passive in the replication hot path: one-sided
+RDMA writes land in follower log memory with no receiver-side check,
+so *silent state divergence* is a first-class failure mode of the
+design ("The Impact of RDMA on Agreement", arXiv:1905.12143, makes the
+same point about RDMA-written replica memory; "Reliable Replication
+Protocols on SmartNICs", arXiv:2503.18093, argues offloaded
+replication needs continuous end-to-end integrity checking). Our TPU
+analog is identical — compiled step programs mutate replicated
+Log/HardState pytrees with zero host-side verification. This module is
+the *correctness observability* leg the metrics registry (PR 1) and
+causal spans (PR 3) do not cover: proving, continuously and cheaply,
+that R replicas (and G×R sharded replicas) hold bit-identical state at
+matching ``(term, index)`` frontiers — and capturing enough recent
+history to debug the step where they stopped.
+
+Three parts, all host-side, stdlib+numpy only:
+
+* :class:`AuditLedger` — consumes the on-device digest windows the
+  compiled step emits under ``audit=True`` (one u32 mul-fold checksum
+  per committed entry in ``[commit - W, commit)``, see
+  ``consensus/step.py``), aligns them across replicas by **absolute**
+  ``(group, term, index)`` (callers add their ``rebased_total`` so i32
+  rollovers never tear the chain), tolerates frontier skew (each
+  replica reports each index on its own schedule; comparison is
+  per-index, not per-step), and raises a ``DIVERGENCE`` finding naming
+  the first mismatching index. Two detection modes: a replica's first
+  report of an index is cross-checked against the other replicas'
+  digests, and every RE-report is checked against the replica's own
+  previous window (vectorized numpy compare) — so post-commit bit
+  corruption is caught even by a single-replica ledger (NodeDaemon).
+* :class:`FlightRecorder` — a bounded ring of the last N step
+  inputs/outputs + digest heads, dumped into a self-contained audit
+  artifact when an alert fires, so the divergence window is
+  inspectable (and, through the chaos reproducer it embeds into,
+  replayable) after the fact.
+* ``python -m rdma_paxos_tpu.obs.audit`` — merges per-replica dumps
+  (each NodeDaemon only observes its own digests) and prints the
+  first-divergence report; also reads audit artifacts and chaos
+  reproducers that embed an audit dump.
+
+HARD RULE (inherited from the rest of ``obs``): nothing here runs
+inside jitted/``shard_map``ped code. The digest computation itself is
+compiled — but only under the static ``audit=`` flag, cache-key
+guarded so default programs stay byte-identical (tests/test_audit.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import os
+import sys
+import tempfile
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from rdma_paxos_tpu.obs.clock import anchor as clock_anchor
+
+# StepOutput fields emitted by the audit=True compiled step — the one
+# list every host integration (SimCluster, ShardedCluster,
+# HostReplicaDriver) extracts by
+AUDIT_KEYS = ("audit_start", "audit_digest", "audit_term")
+
+_SCHEMA = 1
+
+
+def _mask_bits(mask: int) -> List[int]:
+    return [i for i in range(mask.bit_length()) if (mask >> i) & 1]
+
+
+class AuditLedger:
+    """Host-side digest ledger: per-index cross-replica comparison with
+    bounded retention and exact first-divergence localization."""
+
+    # findings are bounded too: a persistently corrupt replica would
+    # otherwise grow findings/_flagged at commit throughput forever
+    # (memory + lock-held summary scans + dump size) while the
+    # operator responds to the page. The first MAX_FINDINGS localize
+    # the divergence; further finding events only tick
+    # ``findings_dropped`` (an EVENT count — post-cap re-reports of
+    # the same index are no longer deduplicated, by design).
+    MAX_FINDINGS = 256
+
+    def __init__(self, n_replicas: int, n_groups: int = 1, *,
+                 history: int = 4096, obs=None):
+        self.R = int(n_replicas)
+        self.G = int(n_groups)
+        self.history = int(history)
+        # Observability facade for divergence counters/trace events;
+        # may be (re)attached after construction — the engines assign
+        # it lazily so driver-attached facades are picked up.
+        self.obs = obs
+        self._lock = threading.Lock()
+        # per group: absolute index -> [term, digest, replica_bitmask]
+        self._idx: List[Dict[int, list]] = [dict() for _ in range(self.G)]
+        self._max: List[int] = [-1] * self.G
+        # per (group, replica): last reported window, for the
+        # vectorized self-recheck fast path
+        self._lastwin: Dict[Tuple[int, int], tuple] = {}
+        self._flagged: set = set()          # (group, index) reported once
+        self.findings: List[dict] = []
+        self.findings_dropped = 0           # events suppressed at cap
+        self.windows = 0
+        self.indices_checked = 0
+
+    # ---------------- recording ----------------
+
+    def record_window(self, replica: int, start: int, digests, terms,
+                      end: int, *, group: int = 0,
+                      step: Optional[int] = None) -> None:
+        """``digests``/``terms`` cover absolute indices ``[start,
+        end)`` of ``replica``'s committed prefix (rebase-corrected by
+        the caller). Re-reported indices are checked against the
+        replica's previous window; first reports join the cross-replica
+        store."""
+        start, end = int(start), int(end)
+        if end <= start:
+            return
+        dig = np.asarray(digests)
+        if dig.dtype != np.uint32:      # device emits u32; normalize
+            dig = dig.astype(np.int64) & 0xFFFFFFFF
+        trm = np.asarray(terms)
+        with self._lock:
+            self.windows += 1
+            key = (group, replica)
+            prev = self._lastwin.get(key)
+            new_from = start
+            if prev is not None:
+                p_start, p_end, p_dig, p_trm = prev
+                if start >= p_start and end >= p_end:
+                    lo, hi = max(start, p_start), min(end, p_end)
+                    if hi > lo:
+                        a = dig[lo - start:hi - start]
+                        b = p_dig[lo - p_start:hi - p_start]
+                        # digest-only detection (the term column is
+                        # FOLDED INTO the digest, so a term flip flips
+                        # the digest too); terms are read back only to
+                        # label the finding
+                        if not np.array_equal(a, b):
+                            j = int(np.argmax(a != b))
+                            self._diverge(
+                                group, lo + j, step, mode="self",
+                                got=(int(trm[lo - start + j]),
+                                     int(a[j])),
+                                got_replicas=[replica],
+                                expected=(int(p_trm[lo - p_start + j]),
+                                          int(b[j])),
+                                expected_replicas=[replica])
+                        new_from = max(new_from, hi)
+                # else: the window regressed (crash-restart recovery
+                # re-reports a lower frontier) — fall through and
+                # re-check every index against the cross-replica store
+            self._lastwin[key] = (start, end, dig, trm)
+
+            store = self._idx[group]
+            bit = 1 << replica
+            if new_from < end:
+                # bulk-convert once: per-element numpy scalar indexing
+                # in this loop was the dominant audit host cost
+                new_t = trm[new_from - start:].tolist()
+                new_d = dig[new_from - start:].tolist()
+                for i, g_idx in enumerate(range(new_from, end)):
+                    t, d = new_t[i], new_d[i]
+                    ent = store.get(g_idx)
+                    if ent is None:
+                        store[g_idx] = [t, d, bit]
+                    elif ent[0] == t and ent[1] == d:
+                        ent[2] |= bit
+                    else:
+                        self._diverge(
+                            group, g_idx, step, mode="replica",
+                            got=(t, d), got_replicas=[replica],
+                            expected=(ent[0], ent[1]),
+                            expected_replicas=_mask_bits(ent[2]))
+                        # the divergent replica's bit is deliberately
+                        # NOT OR'd in: ent's mask means "replicas
+                        # holding THIS digest" — polluting it would
+                        # point dump/merge-based repair at the wrong
+                        # replica set
+                self.indices_checked += end - new_from
+            if end - 1 > self._max[group]:
+                self._max[group] = end - 1
+            if len(store) > 2 * self.history:
+                cut = self._max[group] - self.history
+                for stale in [k for k in store if k < cut]:
+                    del store[stale]
+
+    def _diverge(self, group: int, index: int, step, *, mode: str,
+                 got, got_replicas, expected, expected_replicas) -> None:
+        fkey = (group, index)
+        if fkey in self._flagged:
+            return
+        if len(self.findings) >= self.MAX_FINDINGS:
+            self.findings_dropped += 1
+            return
+        self._flagged.add(fkey)
+        finding = dict(
+            type="DIVERGENCE", mode=mode, group=int(group),
+            index=int(index), term=int(expected[0]),
+            expected_digest=int(expected[1]),
+            expected_replicas=list(expected_replicas),
+            got_term=int(got[0]), got_digest=int(got[1]),
+            got_replicas=list(got_replicas),
+            step=(int(step) if step is not None else None))
+        self.findings.append(finding)
+        if self.obs is not None:
+            from rdma_paxos_tpu.obs import trace as _trace
+            self.obs.metrics.inc("audit_divergence_total", group=group)
+            self.obs.trace.record(
+                _trace.AUDIT_DIVERGENCE,
+                **{k: v for k, v in finding.items() if k != "type"})
+
+    # ---------------- queries / export ----------------
+
+    def first_divergence(self, group: Optional[int] = None
+                         ) -> Optional[dict]:
+        """The finding with the smallest ``(group, index)`` — the first
+        point the replicas stopped agreeing."""
+        cand = [f for f in self.findings
+                if group is None or f["group"] == group]
+        if not cand:
+            return None
+        return min(cand, key=lambda f: (f["group"], f["index"]))
+
+    def summary(self) -> dict:
+        """Deterministic (no wall clock) counters for health snapshots
+        and chaos verdicts."""
+        with self._lock:
+            return dict(
+                n_replicas=self.R, n_groups=self.G,
+                windows=self.windows,
+                indices_checked=self.indices_checked,
+                tracked=sum(len(s) for s in self._idx),
+                findings=len(self.findings),
+                findings_dropped=self.findings_dropped,
+                first=self.first_divergence())
+
+    def dump(self) -> dict:
+        """Full ledger export: the retained per-index digest map (with
+        replica masks) per group, plus every finding — the per-replica
+        document the CLI merges across hosts."""
+        with self._lock:
+            groups = [dict(group=g, max_index=self._max[g],
+                           indices={str(i): [int(e[0]), int(e[1]),
+                                             int(e[2])]
+                                    for i, e in sorted(
+                                        self._idx[g].items())})
+                      for g in range(self.G)]
+            return dict(schema=_SCHEMA, kind="audit_ledger",
+                        anchor=clock_anchor(),
+                        n_replicas=self.R, n_groups=self.G,
+                        windows=self.windows,
+                        indices_checked=self.indices_checked,
+                        findings=[dict(f) for f in self.findings],
+                        findings_dropped=self.findings_dropped,
+                        groups=groups)
+
+    def write_json(self, path: str) -> str:
+        """Atomic (tmp + rename) dump — the NodeDaemon's cadenced
+        per-replica audit file."""
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.dump(), f, indent=2)
+        os.replace(tmp, path)
+        return path
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+def _to_plain(obj):
+    """Recursive numpy/bytes→JSON conversion, applied at DUMP time
+    only — the hot loop records raw arrays and payload bytes so a ring
+    entry costs no per-value Python (measured: eager int/hex
+    conversion was the dominant share of audit overhead)."""
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    if isinstance(obj, np.generic):
+        return obj.item()
+    if isinstance(obj, (bytes, bytearray)):
+        return obj.hex()
+    if isinstance(obj, dict):
+        return {k: _to_plain(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_to_plain(x) for x in obj]
+    return obj
+
+
+class FlightRecorder:
+    """Bounded ring of the last N step records (inputs, outputs, digest
+    heads) — the evidence window an audit artifact ships when an alert
+    fires. Entry values may be numpy arrays/scalars; conversion to
+    plain JSON data happens at :meth:`dump`, never in the record path.
+    The ring holds the most recent ``capacity`` entries."""
+
+    def __init__(self, capacity: int = 64):
+        self.capacity = int(capacity)
+        self._ring: collections.deque = collections.deque(maxlen=capacity)
+        self._lock = threading.Lock()
+
+    def record(self, entry: dict) -> None:
+        with self._lock:
+            self._ring.append(entry)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    def dump(self) -> dict:
+        with self._lock:
+            steps = [_to_plain(e) for e in self._ring]
+        return dict(schema=_SCHEMA, kind="flight",
+                    capacity=self.capacity, anchor=clock_anchor(),
+                    steps=steps)
+
+
+# ---------------------------------------------------------------------------
+# audit artifacts (chaos/artifact.py conventions: one atomic JSON with
+# everything a post-mortem needs)
+# ---------------------------------------------------------------------------
+
+def write_audit_artifact(path: Optional[str] = None, *, reason: str,
+                         ledger: Optional[AuditLedger] = None,
+                         flight: Optional[FlightRecorder] = None,
+                         obs=None, config: Optional[dict] = None,
+                         extra: Optional[dict] = None) -> str:
+    """Persist a self-contained audit artifact (atomic tmp + rename):
+    ledger dump + flight-recorder ring + obs trace/metrics. Returns
+    the path (auto-generated under the system temp dir when None)."""
+    doc = dict(
+        schema=_SCHEMA, kind="audit_artifact", reason=reason,
+        anchor=clock_anchor(), config=config or {},
+        audit=(ledger.dump() if ledger is not None else None),
+        flight=(flight.dump() if flight is not None else None),
+        trace=(obs.trace.dump() if obs is not None else None),
+        metrics=(obs.metrics.snapshot() if obs is not None else None),
+        extra=extra or {},
+    )
+    if path is None:
+        fd, path = tempfile.mkstemp(prefix="audit_dump_", suffix=".json")
+        os.close(fd)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=2)
+    os.replace(tmp, path)
+    return path
+
+
+# ---------------------------------------------------------------------------
+# merge + first-divergence report (multi-host dumps)
+# ---------------------------------------------------------------------------
+
+def _as_ledger_dumps(doc: dict, source: str) -> List[dict]:
+    """Normalize any supported document into ledger-dump dicts: a raw
+    AuditLedger dump, an audit artifact, or a chaos reproducer with an
+    embedded audit dump."""
+    if doc.get("kind") == "audit_ledger" or "groups" in doc:
+        return [doc]
+    if doc.get("kind") == "audit_artifact" and doc.get("audit"):
+        return [doc["audit"]]
+    if isinstance(doc.get("extra"), dict) and doc["extra"].get("audit"):
+        return [doc["extra"]["audit"]]
+    raise SystemExit(f"{source}: not an audit dump, audit artifact, or "
+                     "reproducer with an embedded audit dump")
+
+
+def merge_dumps(dumps: Sequence[dict]) -> dict:
+    """Merge per-replica ledger dumps (e.g. one per NodeDaemon) into
+    one report: each host's own findings are unioned, then shared
+    absolute indices are cross-compared ACROSS dumps — the multi-host
+    equivalent of the in-process ledger's cross-replica check."""
+    findings: List[dict] = []
+    flagged: set = set()
+    for doc in dumps:
+        for f in doc.get("findings", []):
+            k = (f.get("group", 0), f["index"])
+            if k not in flagged:
+                flagged.add(k)
+                findings.append(dict(f))
+    by_group: Dict[int, Dict[int, list]] = {}
+    for doc in dumps:
+        for gdoc in doc.get("groups", []):
+            tgt = by_group.setdefault(int(gdoc["group"]), {})
+            for idx, (t, d, m) in gdoc["indices"].items():
+                tgt.setdefault(int(idx), []).append((int(t), int(d),
+                                                     int(m)))
+    indices = 0
+    for g, idxmap in sorted(by_group.items()):
+        for i, rows in sorted(idxmap.items()):
+            indices += 1
+            if len({(t, d) for (t, d, _m) in rows}) > 1 \
+                    and (g, i) not in flagged:
+                flagged.add((g, i))
+                exp = rows[0]
+                bad = next(r for r in rows
+                           if (r[0], r[1]) != (exp[0], exp[1]))
+                findings.append(dict(
+                    type="DIVERGENCE", mode="merge", group=g, index=i,
+                    term=exp[0], expected_digest=exp[1],
+                    expected_replicas=_mask_bits(exp[2]),
+                    got_term=bad[0], got_digest=bad[1],
+                    got_replicas=_mask_bits(bad[2]), step=None))
+    findings.sort(key=lambda f: (f.get("group", 0), f["index"]))
+    return dict(schema=_SCHEMA, kind="audit_report", dumps=len(dumps),
+                indices=indices, findings=findings,
+                first=(findings[0] if findings else None))
+
+
+def format_report(report: dict) -> str:
+    lines = [f"audit report: {report['dumps']} dump(s), "
+             f"{report['indices']} indices compared, "
+             f"{len(report['findings'])} divergence finding(s)"]
+    first = report.get("first")
+    if first is None:
+        lines.append("no divergence: all reported digests agree")
+    else:
+        lines.append(
+            "FIRST DIVERGENCE: group %d index %d term %d — expected "
+            "digest 0x%08x (replicas %s) got 0x%08x (term %d, replicas "
+            "%s) [%s]" % (
+                first.get("group", 0), first["index"], first["term"],
+                first["expected_digest"], first["expected_replicas"],
+                first["got_digest"], first["got_term"],
+                first["got_replicas"], first.get("mode", "?")))
+        for f in report["findings"][1:6]:
+            lines.append("  also: group %d index %d (0x%08x vs 0x%08x)"
+                         % (f.get("group", 0), f["index"],
+                            f["expected_digest"], f["got_digest"]))
+        if len(report["findings"]) > 6:
+            lines.append(f"  ... {len(report['findings']) - 6} more")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def _load(paths: Sequence[str]) -> List[dict]:
+    dumps: List[dict] = []
+    for p in paths:
+        with open(p) as f:
+            doc = json.load(f)
+        dumps.extend(_as_ledger_dumps(doc, p))
+    return dumps
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m rdma_paxos_tpu.obs.audit",
+        description="Merge per-replica audit dumps and print the "
+                    "first-divergence report.")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    rp = sub.add_parser("report", help="print the merged "
+                        "first-divergence report (exit 1 on divergence)")
+    rp.add_argument("files", nargs="+",
+                    help="audit dumps / audit artifacts / reproducers")
+    mp = sub.add_parser("merge", help="write the merged report JSON")
+    mp.add_argument("files", nargs="+")
+    mp.add_argument("-o", "--out", required=True)
+    args = ap.parse_args(argv)
+
+    report = merge_dumps(_load(args.files))
+    if args.cmd == "merge":
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2)
+        print(f"wrote {args.out}: {len(report['findings'])} finding(s) "
+              f"over {report['indices']} indices from "
+              f"{report['dumps']} dump(s)")
+    else:
+        print(format_report(report))
+    return 1 if report["findings"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -50,6 +50,10 @@ LOG_LINE = "log"                         # routed ReplicaLog event line
 FAULT_INJECTED = "fault_injected"        # chaos nemesis fault applied
 CRASH_RESTART = "crash_restart"          # chaos crash-restart recovery ran
 NEMESIS_VIOLATION = "nemesis_violation"  # chaos invariant/linearize failure
+AUDIT_DIVERGENCE = "audit_divergence"    # digest mismatch at (term, index)
+AUDIT_DUMPED = "audit_dumped"            # audit artifact written
+ALERT_FIRED = "alert_fired"              # SLO alert rule started firing
+ALERT_RESOLVED = "alert_resolved"        # SLO alert rule stopped firing
 
 
 class TraceEvent(NamedTuple):

@@ -86,7 +86,7 @@ def _unsqueeze(tree):
 def build_spmd_step(cfg: LogConfig, n_replicas: int, mesh: Mesh, *,
                     use_pallas: bool = False, interpret: bool = False,
                     donate: bool = True, fanout: str = "gather",
-                    elections: bool = True):
+                    elections: bool = True, audit: bool = False):
     """Compile the protocol step over a real device mesh.
 
     Takes/returns *batched* pytrees (leading ``replica`` axis, sharded one
@@ -98,7 +98,7 @@ def build_spmd_step(cfg: LogConfig, n_replicas: int, mesh: Mesh, *,
     core = functools.partial(
         replica_step, cfg=cfg, n_replicas=n_replicas,
         axis_name=REPLICA_AXIS, use_pallas=use_pallas, interpret=interpret,
-        fanout=fanout, elections=elections)
+        fanout=fanout, elections=elections, audit=audit)
 
     def per_device(state_b, inp_b):
         st, out = core(_squeeze(state_b), _squeeze(inp_b))
@@ -113,7 +113,8 @@ def build_spmd_step(cfg: LogConfig, n_replicas: int, mesh: Mesh, *,
 
 def build_sim_burst(cfg: LogConfig, n_replicas: int, *,
                     use_pallas: bool = False, interpret: bool = False,
-                    donate: bool = True, fanout: str = "gather"):
+                    donate: bool = True, fanout: str = "gather",
+                    audit: bool = False):
     """K protocol steps fused into ONE dispatch (``lax.scan``) over the
     vmapped axis — the multi-step driver mode that amortizes host dispatch
     overhead when the submit queue is deep (the analog of the reference's
@@ -136,7 +137,7 @@ def build_sim_burst(cfg: LogConfig, n_replicas: int, *,
     core = functools.partial(
         replica_step, cfg=cfg, n_replicas=n_replicas,
         axis_name=REPLICA_AXIS, use_pallas=use_pallas, interpret=interpret,
-        fanout=fanout, elections=False)
+        fanout=fanout, elections=False, audit=audit)
     vstep = jax.vmap(core, in_axes=(0, 0), axis_name=REPLICA_AXIS)
 
     def burst(state_b, datas, metas, counts, peer_mask, applied, qdepth):
@@ -167,7 +168,8 @@ def build_sim_burst(cfg: LogConfig, n_replicas: int, *,
 
 def build_spmd_burst(cfg: LogConfig, n_replicas: int, mesh: Mesh, *,
                      use_pallas: bool = False, interpret: bool = False,
-                     donate: bool = True, fanout: str = "gather"):
+                     donate: bool = True, fanout: str = "gather",
+                     audit: bool = False):
     """:func:`build_sim_burst` over a real device mesh (``shard_map`` with
     the K-step scan inside the per-device program)."""
     import jax.numpy as jnp
@@ -176,7 +178,7 @@ def build_spmd_burst(cfg: LogConfig, n_replicas: int, mesh: Mesh, *,
     core = functools.partial(
         replica_step, cfg=cfg, n_replicas=n_replicas,
         axis_name=REPLICA_AXIS, use_pallas=use_pallas, interpret=interpret,
-        fanout=fanout, elections=False)
+        fanout=fanout, elections=False, audit=audit)
 
     def per_device(state_b, datas_b, metas_b, counts_b, peer_b,
                    applied_b, qdepth_b):
@@ -209,7 +211,7 @@ def build_spmd_burst(cfg: LogConfig, n_replicas: int, mesh: Mesh, *,
 def build_sim_group_step(cfg: LogConfig, n_replicas: int, *,
                          use_pallas: bool = False, interpret: bool = False,
                          donate: bool = True, fanout: str = "gather",
-                         elections: bool = True):
+                         elections: bool = True, audit: bool = False):
     """Compile the G-group × R-replica protocol step as ONE program on
     one device (:func:`rdma_paxos_tpu.consensus.step.group_step` under
     ``jit``). The group axis is an unnamed batch axis — groups are
@@ -219,14 +221,15 @@ def build_sim_group_step(cfg: LogConfig, n_replicas: int, *,
     mapped = group_step(cfg=cfg, n_replicas=n_replicas,
                         axis_name=REPLICA_AXIS, use_pallas=use_pallas,
                         interpret=interpret, fanout=fanout,
-                        elections=elections)
+                        elections=elections, audit=audit)
     return jax.jit(mapped, donate_argnums=(0,) if donate else ())
 
 
 def build_sim_group_burst(cfg: LogConfig, n_replicas: int, *,
                           use_pallas: bool = False,
                           interpret: bool = False,
-                          donate: bool = True, fanout: str = "gather"):
+                          donate: bool = True, fanout: str = "gather",
+                          audit: bool = False):
     """:func:`build_sim_burst` with a leading ``group`` batch axis: K
     fused protocol steps over ALL G groups in ONE dispatch
     (``lax.scan`` of the group-batched stable step). Same contract as
@@ -242,7 +245,7 @@ def build_sim_group_burst(cfg: LogConfig, n_replicas: int, *,
     gstep = group_step(cfg=cfg, n_replicas=n_replicas,
                        axis_name=REPLICA_AXIS, use_pallas=use_pallas,
                        interpret=interpret, fanout=fanout,
-                       elections=False)
+                       elections=False, audit=audit)
 
     def burst(state_gb, datas, metas, counts, peer_mask, applied, qdepth):
         zeros_gr = jnp.zeros_like(counts[0])
@@ -261,12 +264,12 @@ def build_sim_group_burst(cfg: LogConfig, n_replicas: int, *,
 def build_sim_step(cfg: LogConfig, n_replicas: int, *,
                    use_pallas: bool = False, interpret: bool = False,
                    donate: bool = True, fanout: str = "gather",
-                   elections: bool = True):
+                   elections: bool = True, audit: bool = False):
     """Compile the protocol step as an N-replica simulation on one device
     (``vmap`` with a named axis — identical collective semantics)."""
     core = functools.partial(
         replica_step, cfg=cfg, n_replicas=n_replicas,
         axis_name=REPLICA_AXIS, use_pallas=use_pallas, interpret=interpret,
-        fanout=fanout, elections=elections)
+        fanout=fanout, elections=elections, audit=audit)
     mapped = jax.vmap(core, in_axes=(0, 0), axis_name=REPLICA_AXIS)
     return jax.jit(mapped, donate_argnums=(0,) if donate else ())

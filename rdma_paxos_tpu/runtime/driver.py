@@ -34,6 +34,7 @@ from rdma_paxos_tpu.consensus.snapshot import (
     install_snapshot, recover_vote, take_snapshot)
 from rdma_paxos_tpu.consensus.state import ConfigState, Role
 from rdma_paxos_tpu.obs import Observability, trace as obs_trace
+from rdma_paxos_tpu.obs.alerts import AlertEngine, default_rules
 from rdma_paxos_tpu.obs.health import HealthReporter, make_snapshot
 from rdma_paxos_tpu.obs.metrics import BATCH_BUCKETS, LATENCY_BUCKETS_S
 from rdma_paxos_tpu.obs.spans import StepPhaseProfiler
@@ -107,7 +108,9 @@ class ClusterDriver:
                  app_snapshot=None, fanout: str = "gather",
                  obs: Optional[Observability] = None,
                  health_period: float = 0.5, link_model=None,
-                 fence: bool = False):
+                 fence: bool = False, audit: bool = False,
+                 alert_rules: Optional[Sequence[dict]] = None,
+                 alert_period: float = 0.25):
         self.cfg = cfg
         self.sync_period = sync_period
         self._workdir = workdir
@@ -159,10 +162,26 @@ class ClusterDriver:
         # fanout="psum" is the production full-connectivity
         # configuration (O(W) fan-out); the default stays "gather" so
         # tests can model partitions (see replica_step's docstring)
+        # audit=True compiles the digest-chain step variants and runs
+        # the cluster AuditLedger + flight recorder (obs/audit.py):
+        # continuous proof that all R replicas hold bit-identical
+        # committed state, with a bounded evidence ring dumped when
+        # the digest-mismatch page fires
         self.cluster = SimCluster(cfg, n_replicas, group_size, mode=mode,
-                                  fanout=fanout)
+                                  fanout=fanout, audit=audit)
         self.cluster.obs = self.obs
         self.cluster.profiler = self._phase_prof
+        # SLO alert rules (obs/alerts.py) evaluated on a cadence from
+        # the poll loop; firing state rides health snapshots and the
+        # alert_firing{alert=...} gauges
+        self.alerts = AlertEngine(
+            self.obs.metrics,
+            rules=(alert_rules if alert_rules is not None
+                   else default_rules()),
+            trace=self.obs.trace)
+        self._alert_period = alert_period
+        self._alert_last = float("-inf")
+        self.audit_artifact: Optional[str] = None
         # chaos hook: a per-link fault model (chaos.faults.LinkModel)
         # driven from outside the poll loop — fault-injection drills
         # against a LIVE driver (apps + stores + poll thread), not just
@@ -535,6 +554,12 @@ class ClusterDriver:
                 self.obs.trace.record(obs_trace.COMMIT_ADVANCE,
                                       replica=r, commit=commit_abs,
                                       delta=delta)
+        # cluster-level leader view (the leaderless alert's input)
+        m.set("cluster_leader", self._leader_view)
+        now = time.monotonic()
+        if now - self._alert_last >= self._alert_period:
+            self._alert_last = now
+            self.evaluate_alerts()
         if self._health is not None and self._health.due():
             try:
                 self._health.write(self._health_snapshots(res))
@@ -575,6 +600,38 @@ class ClusterDriver:
             )
         return snaps
 
+    def evaluate_alerts(self) -> Dict:
+        """One SLO-rule evaluation pass (also called on a cadence from
+        the poll loop). A newly-firing ``page``-severity alert on an
+        audited cluster dumps the audit artifact (ledger + flight ring
+        + obs dumps) for post-mortem."""
+        out = self.alerts.evaluate()
+        pages = [n for n in out["fired"]
+                 if self.alerts.severity(n) == "page"]
+        if pages and (self.cluster.auditor is not None
+                      or self.cluster.flight is not None):
+            self._dump_audit_artifact("alert: " + ",".join(pages))
+        return out
+
+    def _dump_audit_artifact(self, reason: str) -> Optional[str]:
+        from rdma_paxos_tpu.obs.audit import write_audit_artifact
+        path = (os.path.join(self._workdir, "audit_dump.json")
+                if self._workdir else None)
+        try:
+            self.audit_artifact = write_audit_artifact(
+                path, reason=reason, ledger=self.cluster.auditor,
+                flight=self.cluster.flight, obs=self.obs,
+                config=dict(n_replicas=self.R,
+                            n_slots=self.cfg.n_slots,
+                            slot_bytes=self.cfg.slot_bytes,
+                            window_slots=self.cfg.window_slots))
+        except OSError:
+            # evidence I/O must never kill the data path
+            return None
+        self.obs.trace.record(obs_trace.AUDIT_DUMPED, reason=reason,
+                              path=self.audit_artifact)
+        return self.audit_artifact
+
     def health(self) -> Dict:
         """Aggregated cluster health (live — not from the files): the
         per-replica snapshots plus the cluster-level view. Safe to call
@@ -590,6 +647,10 @@ class ClusterDriver:
             rebase_stalled=self.cluster.rebase_stalled,
             loop_error=(repr(self.loop_error)
                         if self.loop_error else None),
+            audit=(self.cluster.auditor.summary()
+                   if self.cluster.auditor is not None else None),
+            alerts=self.alerts.state(),
+            audit_artifact=self.audit_artifact,
             ts=time.time(),
         )
 

@@ -58,7 +58,7 @@ class HostReplicaDriver:
                  num_processes: int, coordinator: str,
                  group_size: Optional[int] = None,
                  initialize_distributed: bool = True,
-                 fanout: str = "psum"):
+                 fanout: str = "psum", audit: bool = False):
         if initialize_distributed:
             jax.distributed.initialize(
                 coordinator_address=coordinator,
@@ -75,8 +75,14 @@ class HostReplicaDriver:
         # real deployments run full-connectivity meshes: the O(W) psum
         # fan-out is sound there (see replica_step's fanout docstring)
         self._fanout = fanout
+        # audit=True compiles the digest-chain variant (see
+        # consensus/step.py): each host extracts ITS replica's digest
+        # windows and records them locally; cross-host comparison
+        # happens by merging the per-replica audit dumps
+        # (python -m rdma_paxos_tpu.obs.audit report ...)
+        self._audit = audit
         self._step = build_spmd_step(
-            cfg, self.R, self.mesh, fanout=fanout,
+            cfg, self.R, self.mesh, fanout=fanout, audit=audit,
             # same kernel as the benches: Pallas quorum scan on TPU
             use_pallas=jax.default_backend() == "tpu")
         # one jitted burst builder (lazily built): the scan length
@@ -222,7 +228,9 @@ class HostReplicaDriver:
         inp = self.make_input(**kw)
         self.state, out = self._step(self.state, inp)
         res = {}
-        for k in OUT_KEYS:
+        keys = OUT_KEYS + (("audit_start", "audit_digest",
+                            "audit_term") if self._audit else ())
+        for k in keys:
             arr = getattr(out, k)
             local = [s for s in arr.addressable_shards
                      if s.index[0].start == self.me]
@@ -248,6 +256,7 @@ class HostReplicaDriver:
             from rdma_paxos_tpu.parallel.mesh import build_spmd_burst
             self._burst = build_spmd_burst(
                 self.cfg, self.R, self.mesh, fanout=self._fanout,
+                audit=self._audit,
                 use_pallas=jax.default_backend() == "tpu")
         return self._burst
 
@@ -293,6 +302,18 @@ class HostReplicaDriver:
             acc = [s for s in outs.accepted.addressable_shards
                    if s.index[1].start == self.me]
             res["accepted"] = np.asarray(acc[0].data[:, 0]).sum()
+        if self._audit:
+            # audit windows for EVERY fused step (not just the last) —
+            # the daemon ingests them in order so the digest-chain
+            # tiling holds through bursts; audit_commit carries the
+            # matching per-step commit frontiers
+            for k in ("audit_start", "audit_digest", "audit_term",
+                      "commit"):
+                arr = getattr(outs, k)          # [K, R, ...]
+                local = [s for s in arr.addressable_shards
+                         if s.index[1].start == self.me]
+                res["audit_commit" if k == "commit" else k] = (
+                    np.asarray(local[0].data[:, 0]) if local else None)
         return res
 
     def rebase(self, delta: int) -> None:

@@ -68,9 +68,20 @@ class NodeDaemon:
         # elastic generations (process_id is the SLOT in this world; the
         # host_id is forever)
         self.host_id = process_id if host_id is None else host_id
+        # RP_AUDIT=1 compiles the digest-chain step variant (must MATCH
+        # on every host — the audit program is part of the collective
+        # schedule) and records this replica's digest windows into a
+        # local AuditLedger, dumped on a cadence to
+        # <workdir>/replica<me>.audit.json; merge the per-host dumps
+        # with `python -m rdma_paxos_tpu.obs.audit report ...` for the
+        # cross-replica first-divergence verdict. The local ledger
+        # alone already catches post-commit corruption of THIS host's
+        # log memory (re-reported windows are self-checked).
+        self._audit = os.environ.get("RP_AUDIT") == "1"
         self.hd = HostReplicaDriver(
             cfg, process_id=process_id, num_processes=num_processes,
-            coordinator=coordinator, group_size=group_size)
+            coordinator=coordinator, group_size=group_size,
+            audit=self._audit)
         if genesis is not None:
             # elastic world rebuild: every member installs the identical
             # donor-derived row (collective — all daemons of the
@@ -133,6 +144,24 @@ class NodeDaemon:
             replica=self.me, obs=self.obs)
         self.timer = ElectionTimer(timeout_cfg or TimeoutConfig(),
                                    seed=seed + process_id)
+        if self._audit:
+            from rdma_paxos_tpu.obs.audit import AuditLedger
+            self.auditor = AuditLedger(num_processes, obs=self.obs)
+            self._audit_path = os.path.join(
+                workdir, f"replica{self.me}.audit.json")
+        else:
+            self.auditor = None
+            self._audit_path = None
+        self._audit_write_period = 5.0
+        self._audit_last_write = float("-inf")
+        # SLO alert rules over the process-global registry, evaluated
+        # on a cadence from the lock-step loop (obs/alerts.py)
+        from rdma_paxos_tpu.obs.alerts import AlertEngine, default_rules
+        self.alerts = AlertEngine(self.obs.metrics,
+                                  rules=default_rules(),
+                                  trace=self.obs.trace)
+        self._alert_period = 1.0
+        self._alert_last = float("-inf")
         self.last: Optional[Dict] = None
         self._rebase_warned = False
         # consecutive post-threshold iterations with the gathered
@@ -343,6 +372,11 @@ class NodeDaemon:
             if acc < take_n:
                 with self._lock:
                     self._submitq = take[acc:] + self._submitq
+        if self.auditor is not None \
+                and res.get("audit_digest") is not None:
+            # BEFORE the rollover below: the emitted indices are raw,
+            # consistent with the current _rebased_total
+            self._ingest_audit(res)
         self.hard.save(int(res["term"]), int(res["voted_term"]),
                        int(res["voted_for"]))
         was_leader = self._is_leader
@@ -525,11 +559,48 @@ class NodeDaemon:
         self.obs.metrics.set("rebase_headroom",
                              self.cfg.rebase_threshold
                              - int(res["end"]), replica=self.me)
+        self.obs.metrics.set("cluster_leader", int(res["leader_id"]))
         with self._lock:
             self.obs.metrics.set("inflight_waiters", len(self.inflight),
                                  replica=self.me)
+        import time as _tmono
+        now = _tmono.monotonic()
+        if now - self._alert_last >= self._alert_period:
+            self._alert_last = now
+            self.alerts.evaluate()
+        if (self._audit_path is not None and self.auditor is not None
+                and now - self._audit_last_write
+                >= self._audit_write_period):
+            self._audit_last_write = now
+            try:
+                self.auditor.write_json(self._audit_path)
+            except OSError:
+                pass     # evidence I/O must never kill the data path
         self.last = res
         return res
+
+    def _ingest_audit(self, res: Dict) -> None:
+        """Record this replica's digest windows (single step or every
+        fused burst step) into the local ledger in ABSOLUTE indices."""
+        led = self.auditor
+        W = self.cfg.window_slots
+        reb = self._rebased_total
+        dig = res["audit_digest"]
+        if dig.ndim == 1:
+            rows = [(int(res["audit_start"]), int(res["commit"]),
+                     dig, res["audit_term"])]
+        else:                              # burst: [K, W] windows
+            rows = [(int(res["audit_start"][k]),
+                     int(res["audit_commit"][k]), dig[k],
+                     res["audit_term"][k])
+                    for k in range(dig.shape[0])]
+        for start, commit, d, t in rows:
+            n = commit - start
+            if n <= 0:
+                continue
+            off = start - (commit - W)
+            led.record_window(self.me, start + reb, d[off:off + n],
+                              t[off:off + n], commit + reb)
 
     def bootstrap_from_store(self) -> None:
         """Rebuild a FRESH local app instance by replaying the stable
@@ -604,6 +675,11 @@ class NodeDaemon:
                 time.sleep(period)
 
     def close(self) -> None:
+        if self.auditor is not None and self._audit_path is not None:
+            try:
+                self.auditor.write_json(self._audit_path)
+            except OSError:
+                pass
         self.proxy.close()
         if self.replay:
             self.replay.close()

@@ -39,6 +39,25 @@ from rdma_paxos_tpu.utils.codec import bytes_to_words
 STEP_CACHE: Dict[tuple, object] = {}
 
 
+def assemble_frames(types, conns, lens, raw, idxs) -> bytes:
+    """Store-ready framed blob for the client entries at ``idxs`` of a
+    decoded window: ``([u32 len][u8 etype][u32 conn][payload])*``,
+    assembled in two numpy passes (fill + ragged masked gather) — zero
+    per-record Python on the store path. ONE implementation shared by
+    SimCluster and ShardedCluster so the byte format can never drift
+    between the engines (the G=1 parity contract)."""
+    row = raw.shape[1]
+    cl = lens[idxs].astype(np.uint32)
+    mat = np.zeros((idxs.size, 9 + row), np.uint8)
+    mat[:, 0:4] = (cl + 5).astype("<u4")[:, None].view(np.uint8)
+    mat[:, 4] = types[idxs]
+    mat[:, 5:9] = conns[idxs].astype("<i4")[:, None].view(np.uint8)
+    mat[:, 9:] = raw[idxs]
+    keep = (np.arange(9 + row, dtype=np.uint32)[None]
+            < (9 + cl)[:, None])
+    return mat[keep].tobytes()
+
+
 class SimCluster:
     """N-replica protocol simulation with host-side bookkeeping."""
 
@@ -50,11 +69,26 @@ class SimCluster:
                  group_size: Optional[int] = None, *, mode: str = "sim",
                  use_pallas: Optional[bool] = None,
                  interpret: bool = False,
-                 fanout: str = "gather", stable_fast_path: bool = True):
+                 fanout: str = "gather", stable_fast_path: bool = True,
+                 audit: bool = False, flight_capacity: int = 64):
         self.cfg = cfg
         self.R = n_replicas
         self.group_size = group_size or n_replicas
         self._mode = mode
+        # correctness observability (obs/audit.py): audit=True compiles
+        # the digest-chain step variants (distinct cache keys — the
+        # default programs are untouched), feeds every step's digest
+        # windows to a cluster AuditLedger, and records a bounded
+        # flight ring of step inputs/outputs for post-mortem dumps
+        self._audit = audit
+        if audit:
+            from rdma_paxos_tpu.obs.audit import (
+                AuditLedger, FlightRecorder)
+            self.auditor = AuditLedger(n_replicas)
+            self.flight = FlightRecorder(flight_capacity)
+        else:
+            self.auditor = None
+            self.flight = None
         # production default: the Pallas quorum kernel on TPU (same code
         # path as the benches), jnp reference scan elsewhere
         if use_pallas is None:
@@ -234,20 +268,26 @@ class SimCluster:
     K_TIERS = (2, 4, 8, 16)
 
     def _burst_fn(self, K: int):
+        # the "audit" marker is appended ONLY when auditing: default
+        # clusters' cache keys are bit-identical to the pre-audit ones
+        # (tests/test_audit.py guards exactly this)
         key = (self.cfg, self.R, self._mode, self._use_pallas,
-               self._interpret, self._fanout, "burst", K)
+               self._interpret, self._fanout, "burst", K) \
+            + (("audit",) if self._audit else ())
         fn = self._STEP_CACHE.get(key)
         if fn is None:
             if self._mode == "spmd":
                 fn = build_spmd_burst(self.cfg, self.R, self.mesh,
                                       use_pallas=self._use_pallas,
                                       interpret=self._interpret,
-                                      fanout=self._fanout)
+                                      fanout=self._fanout,
+                                      audit=self._audit)
             else:
                 fn = build_sim_burst(self.cfg, self.R,
                                      use_pallas=self._use_pallas,
                                      interpret=self._interpret,
-                                     fanout=self._fanout)
+                                     fanout=self._fanout,
+                                     audit=self._audit)
             self._STEP_CACHE[key] = fn
         return fn
 
@@ -327,6 +367,17 @@ class SimCluster:
         res["accepted"] = acc
         if prof is not None:
             prof.stop("quorum_wait")
+        if self._audit:
+            # each fused step emitted its own digest window: ingest
+            # them in order so the tiling property (no gaps) holds
+            a_s = np.asarray(outs.audit_start)      # [K, R]
+            a_d = np.asarray(outs.audit_digest)     # [K, R, W]
+            a_t = np.asarray(outs.audit_term)       # [K, R, W]
+            a_c = np.asarray(outs.commit)           # [K, R]
+            for k in range(a_s.shape[0]):
+                self._ingest_audit(a_s[k], a_d[k], a_t[k], a_c[k])
+            res["audit_start"], res["audit_digest"] = a_s[-1], a_d[-1]
+            res["audit_term"] = a_t[-1]
         # Shortfall: appends stop entirely the step the replica is not
         # leader and the capacity clamp drops suffixes only, so the
         # appended set is always a PREFIX of ``taken`` — requeue the
@@ -346,6 +397,8 @@ class SimCluster:
         self._replay_committed(res)
         if prof is not None:
             prof.stop("apply")
+        if self._audit:
+            self._record_flight(res, taken, (), burst_k=K)
         self._maybe_rebase(res)
         self.last = res
         self.step_index += K
@@ -357,12 +410,13 @@ class SimCluster:
         static config — the single source for both the full and stable
         variants, so they can never drift apart in build flags."""
         key = (self.cfg, self.R, self._mode, self._use_pallas,
-               self._interpret, self._fanout, elections)
+               self._interpret, self._fanout, elections) \
+            + (("audit",) if self._audit else ())
         cached = self._STEP_CACHE.get(key)
         if cached is None:
             kw = dict(use_pallas=self._use_pallas,
                       interpret=self._interpret, fanout=self._fanout,
-                      elections=elections)
+                      elections=elections, audit=self._audit)
             if self._mode == "spmd":
                 cached = build_spmd_step(self.cfg, self.R, self.mesh, **kw)
             else:
@@ -426,6 +480,16 @@ class SimCluster:
                          "leadership_verified", "rebase_delta")}
         if prof is not None:
             prof.stop("quorum_wait")
+        if self._audit:
+            # after the quorum_wait stop: audit host work must not
+            # inflate the PR3 phase attribution it sits next to
+            for k in ("audit_start", "audit_digest", "audit_term"):
+                res[k] = np.asarray(getattr(out, k))
+            # ingest BEFORE _maybe_rebase: the emitted indices are raw
+            # (pre-rollover), consistent with the current rebased_total
+            self._ingest_audit(res["audit_start"], res["audit_digest"],
+                               res["audit_term"], res["commit"])
+            flight_taken = [list(t) for t in self._inflight]
         # ring-full backpressure: entries the leader could not append are
         # requeued in order (submissions to non-leaders are dropped by
         # design — proxy submits on the leader only)
@@ -442,11 +506,60 @@ class SimCluster:
         self._replay_committed(res)
         if prof is not None:
             prof.stop("apply")
+        if self._audit:
+            self._record_flight(res, flight_taken, timeouts)
         self._maybe_rebase(res)
         self.last = res
         self.step_index += 1
         self._observe_spans(res)
         return res
+
+    # ------------------------------------------------------------------
+    # silent-divergence auditing (obs/audit.py; audit=True clusters)
+    # ------------------------------------------------------------------
+
+    def _ingest_audit(self, starts, digests, terms, commits) -> None:
+        """Feed one step's per-replica digest windows to the ledger,
+        converted to ABSOLUTE indices (raw + rebased_total — callers
+        run this before _maybe_rebase so the two stay consistent)."""
+        led = self.auditor
+        led.obs = self.obs              # pick up a late-attached facade
+        W = self.cfg.window_slots
+        reb = self.rebased_total
+        s_l, c_l = starts.tolist(), commits.tolist()
+        for r in range(self.R):
+            start, commit = s_l[r], c_l[r]
+            n = commit - start
+            if n <= 0:
+                continue
+            off = start - (commit - W)
+            led.record_window(r, start + reb,
+                              digests[r, off:off + n],
+                              terms[r, off:off + n], commit + reb,
+                              step=self.step_index)
+
+    def _record_flight(self, res, taken, timeouts,
+                       burst_k: int = 1) -> None:
+        """One flight-recorder entry per dispatch: the step's inputs
+        (per-replica submitted batches), scalar outputs, host apply
+        cursors, and per-replica digest heads — raw offsets plus the
+        rebased_total in force, so the dump is self-describing.
+        Values stay numpy arrays / payload bytes (fresh per step,
+        copied where a later in-place mutation could reach them); the
+        recorder converts to plain JSON data at dump time only."""
+        entry = dict(
+            step=self.step_index, burst_k=burst_k,
+            timeouts=[int(t) for t in timeouts],
+            rebased_total=int(self.rebased_total),
+            inputs=taken,
+            outputs={k: res[k].copy()
+                     for k in ("term", "role", "leader_id", "head",
+                               "apply", "commit", "end", "accepted")},
+            applied=self.applied.copy(),
+            digests=dict(start=res["audit_start"].copy(),
+                         commit=res["commit"].copy(),
+                         window=res["audit_digest"]))
+        self.flight.record(entry)
 
     # ------------------------------------------------------------------
     # span hooks (host-side causal tracing — obs.spans; all no-ops
@@ -543,6 +656,10 @@ class SimCluster:
         self.applied -= delta
         for k in ("head", "apply", "commit", "end"):
             res[k] = res[k] - delta
+        # keep the returned dict self-consistent: audit_start is an
+        # index too (the ledger already ingested pre-rollover)
+        if "audit_start" in res:
+            res["audit_start"] = res["audit_start"] - delta
         self.rebases += 1
         self.rebased_total += delta
         self.rebase_stall_steps = 0          # re-arm stall detection
@@ -608,22 +725,8 @@ class SimCluster:
                                     int(reqs[j]),
                                     buf[o:o + int(lens[j])]))
                     if self.collect_frames:
-                        # frame = [u32 len][u8 etype][u32 conn][payload]
-                        # assembled for ALL client entries in two numpy
-                        # passes (fill + ragged masked gather) — zero
-                        # per-record Python on the store path
-                        k = idxs.size
-                        cl = lens[idxs].astype(np.uint32)
-                        mat = np.zeros((k, 9 + row), np.uint8)
-                        mat[:, 0:4] = (cl + 5).astype("<u4")[:, None] \
-                            .view(np.uint8)
-                        mat[:, 4] = types[idxs]
-                        mat[:, 5:9] = conns[idxs].astype("<i4")[:, None] \
-                            .view(np.uint8)
-                        mat[:, 9:] = raw[idxs]
-                        keep = (np.arange(9 + row, dtype=np.uint32)[None]
-                                < (9 + cl)[:, None])
-                        self.frames[r].append(mat[keep].tobytes())
+                        self.frames[r].append(assemble_frames(
+                            types, conns, lens, raw, idxs))
                 self.applied[r] += n
 
     # ---------------- inspection ----------------

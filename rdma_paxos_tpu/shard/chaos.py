@@ -63,7 +63,7 @@ class ShardNemesisRunner:
                  seed: int = 0, steps: int = 60, crash_step: int = 20,
                  reelect_after: int = 4, target_group: int = 0,
                  settle_steps: int = 12, keys_per_group: int = 2,
-                 obs=None):
+                 obs=None, audit: bool = True):
         self.cfg = cfg or DEFAULT_KV_CFG
         self.R, self.G = int(n_replicas), int(n_groups)
         self.seed = int(seed)
@@ -72,7 +72,10 @@ class ShardNemesisRunner:
         self.reelect_after = int(reelect_after)
         self.target = int(target_group)
         self.settle_steps = int(settle_steps)
-        self.shard = ShardedCluster(self.cfg, self.R, self.G)
+        # audit at 100% by default: a passing shard nemesis also proves
+        # bit-identical per-group replicated state through the outage
+        self.shard = ShardedCluster(self.cfg, self.R, self.G,
+                                    audit=audit)
         self.shard.obs = obs
         self.kv = ShardedKVS(self.shard, cap=256)
         # the fault domain is ONE group: the link model is attached to
@@ -165,13 +168,19 @@ class ShardNemesisRunner:
         target_recovered = (f_end[self.target]
                             > f_at_crash[self.target])
         new_leader = self.shard.leader_hint(self.target)
+        audit_summary = (self.shard.auditor.summary()
+                         if self.shard.auditor is not None else None)
+        audit_ok = (audit_summary is None
+                    or audit_summary["findings"] == 0)
         ok = (not violations and others_advanced and target_recovered
-              and new_leader >= 0 and new_leader != crashed)
+              and new_leader >= 0 and new_leader != crashed
+              and audit_ok)
         return dict(
             ok=ok, seed=self.seed, steps=self.steps,
             target_group=self.target, crashed_leader=crashed,
             new_leader=new_leader,
             invariant_violations=violations,
+            audit=audit_summary,
             frontiers=dict(at_crash=f_at_crash, at_heal=f_at_heal,
                            at_end=f_end),
             others_advanced=others_advanced,

@@ -45,7 +45,8 @@ from rdma_paxos_tpu.consensus.state import Role
 from rdma_paxos_tpu.consensus.step import StepInput, fetch_window
 from rdma_paxos_tpu.parallel.mesh import (
     build_sim_group_burst, build_sim_group_step, stack_group_states)
-from rdma_paxos_tpu.runtime.sim import STEP_CACHE, SimCluster
+from rdma_paxos_tpu.runtime.sim import (
+    STEP_CACHE, SimCluster, assemble_frames)
 from rdma_paxos_tpu.shard.router import KeyRouter
 from rdma_paxos_tpu.utils.codec import bytes_to_words
 
@@ -67,13 +68,13 @@ class ShardedCluster:
     rules are the same ones, widened by a group index; any change to
     SimCluster's step/requeue/replay/rebase logic must be mirrored
     here — the G=1 bit-equivalence test in ``tests/test_shard.py``
-    catches drift in everything it exercises): deliberately NOT
-    carried over are ``collect_frames``/``frames`` (store-ready frame
-    assembly — the sharded engine has no driver/StableStore
-    integration yet, see ROADMAP) and the ``StepPhaseProfiler`` hooks
-    (single-group profiling covers the shared step path). Unifying
-    the two engines' host bookkeeping behind one helper is a ROADMAP
-    open item."""
+    catches drift in everything it exercises): ``collect_frames`` /
+    ``frames`` (store-ready frame assembly) and the
+    ``StepPhaseProfiler`` hooks now have full parity (phase
+    histograms additionally carry ``{group=g}`` apply attribution);
+    ``audit=True`` mirrors SimCluster's digest auditing with
+    ``(group, term, index)`` ledger keys. Unifying the two engines'
+    host bookkeeping behind one helper is a ROADMAP open item."""
 
     K_TIERS = SimCluster.K_TIERS
     REBASE_STALL_STEPS = REBASE_STALL_STEPS
@@ -83,7 +84,8 @@ class ShardedCluster:
                  use_pallas: Optional[bool] = None,
                  interpret: bool = False, fanout: str = "gather",
                  stable_fast_path: bool = True,
-                 group_size: Optional[int] = None):
+                 group_size: Optional[int] = None,
+                 audit: bool = False, flight_capacity: int = 64):
         if n_groups < 1:
             raise ValueError("n_groups must be >= 1")
         self.cfg = cfg
@@ -98,6 +100,18 @@ class ShardedCluster:
         self._interpret = interpret
         self._fanout = fanout
         self._stable_fast_path = stable_fast_path
+        # correctness observability (obs/audit.py): per-group digest
+        # auditing keyed (group, term, index) — same mechanism as
+        # SimCluster, widened by the group axis
+        self._audit = audit
+        if audit:
+            from rdma_paxos_tpu.obs.audit import (
+                AuditLedger, FlightRecorder)
+            self.auditor = AuditLedger(self.R, self.G)
+            self.flight = FlightRecorder(flight_capacity)
+        else:
+            self.auditor = None
+            self.flight = None
         self.state = stack_group_states(cfg, self.G, self.R,
                                         self.group_size)
         self._step_full = self._build_step(elections=True)
@@ -137,6 +151,17 @@ class ShardedCluster:
         self.step_index = 0
         # host-side observability facade; NEVER read inside jitted code
         self.obs = None
+        # optional obs.spans.StepPhaseProfiler — same hook points as
+        # SimCluster (host_encode / device_dispatch / fenced sync /
+        # quorum_wait / apply), plus per-group apply attribution
+        # (step_phase_us{phase=apply, group=g}) recorded via self.obs
+        self.profiler = None
+        # store-ready framed blobs, per group per replica — byte-
+        # identical to SimCluster's assembly (the G=1 parity contract);
+        # only produced when a consumer opts in
+        self.collect_frames = False
+        self.frames: List[List[List[bytes]]] = [
+            [[] for _ in range(R)] for _ in range(G)]
 
     # ---------------- client-side API ----------------
 
@@ -245,24 +270,27 @@ class ShardedCluster:
         the jitted callable is batch-size-polymorphic, so every
         homogeneous cluster shape shares one entry per variant."""
         key = (self.cfg, self.R, "sim", self._use_pallas,
-               self._interpret, self._fanout, "group", elections)
+               self._interpret, self._fanout, "group", elections) \
+            + (("audit",) if self._audit else ())
         cached = STEP_CACHE.get(key)
         if cached is None:
             cached = build_sim_group_step(
                 self.cfg, self.R, use_pallas=self._use_pallas,
                 interpret=self._interpret, fanout=self._fanout,
-                elections=elections)
+                elections=elections, audit=self._audit)
             STEP_CACHE[key] = cached
         return cached, key
 
     def _burst_fn(self, K: int):
         key = (self.cfg, self.R, "sim", self._use_pallas,
-               self._interpret, self._fanout, "group-burst", K)
+               self._interpret, self._fanout, "group-burst", K) \
+            + (("audit",) if self._audit else ())
         fn = STEP_CACHE.get(key)
         if fn is None:
             fn = build_sim_group_burst(
                 self.cfg, self.R, use_pallas=self._use_pallas,
-                interpret=self._interpret, fanout=self._fanout)
+                interpret=self._interpret, fanout=self._fanout,
+                audit=self._audit)
             STEP_CACHE[key] = fn
         return fn, key
 
@@ -299,6 +327,9 @@ class ShardedCluster:
         ``timeouts`` fires election timers per group: a dict
         ``{group: [replica, ...]}`` or an iterable of ``(group,
         replica)`` pairs. Returns ``[G, R]`` result arrays."""
+        prof = self.profiler
+        if prof is not None:
+            prof.start("host_encode")
         tmo = self._norm_timeouts(timeouts)
         inp = self._build_inputs(tmo)
         # no timer fired in ANY group ⟹ Phase B is provably a no-op
@@ -307,10 +338,26 @@ class ShardedCluster:
             fn, key = self._build_step(elections=False)
         else:
             fn, key = self._step_full
+        if prof is not None:
+            prof.stop("host_encode")
+            prof.start("device_dispatch")
         self.state, out = fn(self.state, inp)
+        if prof is not None:
+            prof.stop("device_dispatch")
+            prof.sync(out)              # fenced device_sync (opt-in)
+            prof.start("quorum_wait")
         self.dispatches += 1
         self.programs_used.add(key)
         res = {k: np.asarray(getattr(out, k)) for k in _RES_KEYS}
+        if prof is not None:
+            prof.stop("quorum_wait")
+        if self._audit:
+            for k in ("audit_start", "audit_digest", "audit_term"):
+                res[k] = np.asarray(getattr(out, k))
+            self._ingest_audit(res["audit_start"], res["audit_digest"],
+                               res["audit_term"], res["commit"])
+            flight_taken = [[list(t) for t in row]
+                            for row in self._inflight]
         for g in range(self.G):
             for r in range(self.R):
                 take = self._inflight[g][r]
@@ -321,7 +368,13 @@ class ShardedCluster:
                     if acc < len(take):
                         self.pending[g][r] = (take[acc:]
                                               + self.pending[g][r])
+        if prof is not None:
+            prof.start("apply")
         self._replay_committed(res)
+        if prof is not None:
+            prof.stop("apply")
+        if self._audit:
+            self._record_flight(res, flight_taken, tmo)
         self._maybe_rebase(res)
         self.last = res
         self.step_index += 1
@@ -336,6 +389,9 @@ class ShardedCluster:
         while every trafficked group has a known leader."""
         cfg, G, R, B = self.cfg, self.G, self.R, self.cfg.batch_slots
         assert self.last is not None, "burst requires a stepped cluster"
+        prof = self.profiler
+        if prof is not None:
+            prof.start("host_encode")
         take_n = np.zeros((G, R), np.int64)
         for g in range(G):
             for r in range(R):
@@ -377,17 +433,35 @@ class ShardedCluster:
                 "psum fan-out requires full connectivity; use "
                 "fanout='gather' to model partitions")
         fn, key = self._burst_fn(K)
+        if prof is not None:
+            prof.stop("host_encode")
+            prof.start("device_dispatch")
         self.state, outs = fn(
             self.state, jnp.asarray(data), jnp.asarray(meta),
             jnp.asarray(count), jnp.asarray(mask),
             jnp.asarray(self.applied.astype(np.int32)),
             jnp.asarray(qdepth))
+        if prof is not None:
+            prof.stop("device_dispatch")
+            prof.sync(outs)             # fenced device_sync (opt-in)
+            prof.start("quorum_wait")
         self.dispatches += 1
         self.programs_used.add(key)
         res = {k: np.asarray(getattr(outs, k))[-1]
                for k in _RES_KEYS if k != "accepted"}
         acc = np.asarray(outs.accepted).sum(axis=0)          # [G, R]
         res["accepted"] = acc
+        if prof is not None:
+            prof.stop("quorum_wait")
+        if self._audit:
+            a_s = np.asarray(outs.audit_start)      # [K, G, R]
+            a_d = np.asarray(outs.audit_digest)     # [K, G, R, W]
+            a_t = np.asarray(outs.audit_term)       # [K, G, R, W]
+            a_c = np.asarray(outs.commit)           # [K, G, R]
+            for k in range(a_s.shape[0]):
+                self._ingest_audit(a_s[k], a_d[k], a_t[k], a_c[k])
+            res["audit_start"], res["audit_digest"] = a_s[-1], a_d[-1]
+            res["audit_term"] = a_t[-1]
         for g in range(G):
             for r in range(R):
                 if taken[g][r] and res["role"][g, r] == int(Role.LEADER):
@@ -396,7 +470,13 @@ class ShardedCluster:
                     if a < len(taken[g][r]):
                         self.pending[g][r] = (taken[g][r][a:]
                                               + self.pending[g][r])
+        if prof is not None:
+            prof.start("apply")
         self._replay_committed(res)
+        if prof is not None:
+            prof.stop("apply")
+        if self._audit:
+            self._record_flight(res, taken, {}, burst_k=K)
         self._maybe_rebase(res)
         self.last = res
         self.step_index += K
@@ -411,8 +491,13 @@ class ShardedCluster:
         ``fetch_window``). Same integrity rule as ``SimCluster``: a
         fetched entry whose stamped gidx disagrees with the expected
         apply index means the slot was recycled past this member —
-        flag ``(g, r)`` for snapshot recovery and stop replaying."""
+        flag ``(g, r)`` for snapshot recovery and stop replaying.
+        Frame assembly and the per-group apply-time histograms
+        (``step_phase_us{phase=apply, group=g}``) ride the same decode
+        pass."""
+        import time as _time
         W = self._replay_W
+        t_group: Dict[int, int] = {}
         while True:
             todo = [(g, r) for g in range(self.G)
                     for r in range(self.R)
@@ -420,12 +505,13 @@ class ShardedCluster:
                     and (g, r) not in self.need_recovery
                     and self.applied[g, r] < int(res["commit"][g, r])]
             if not todo:
-                return
+                break
             starts = jnp.asarray(self.applied.astype(np.int32))
             wd_all, wm_all = self._fetch_all(self.state.log, starts)
             self.fetch_dispatches += 1
             wd_all, wm_all = np.asarray(wd_all), np.asarray(wm_all)
             for g, r in todo:
+                t0 = _time.perf_counter_ns()
                 commit = int(res["commit"][g, r])
                 n = int(min(commit - self.applied[g, r], W))
                 wd, wm = wd_all[g, r], wm_all[g, r]
@@ -450,7 +536,19 @@ class ShardedCluster:
                         rep.append((int(types[j]), int(conns[j]),
                                     int(reqs[j]),
                                     buf[o:o + int(lens[j])]))
+                    if self.collect_frames:
+                        self.frames[g][r].append(assemble_frames(
+                            types, conns, lens, raw, idxs))
                 self.applied[g, r] += n
+                t_group[g] = (t_group.get(g, 0)
+                              + _time.perf_counter_ns() - t0)
+        if (t_group and self.obs is not None
+                and self.profiler is not None):
+            from rdma_paxos_tpu.obs.metrics import LATENCY_BUCKETS_US
+            for g, ns in sorted(t_group.items()):
+                self.obs.metrics.observe(
+                    "step_phase_us", ns / 1e3,
+                    buckets=LATENCY_BUCKETS_US, phase="apply", group=g)
 
     def _rebase_stalled_step(self, g: int, res) -> None:
         self.rebase_stall_steps[g] += 1
@@ -501,6 +599,10 @@ class ShardedCluster:
             self.applied[g] -= d
             for k in ("head", "apply", "commit", "end"):
                 res[k][g] = res[k][g] - d
+            # keep the returned dict self-consistent: audit_start is
+            # an index too (the ledger already ingested pre-rollover)
+            if "audit_start" in res:
+                res["audit_start"][g] = res["audit_start"][g] - d
             self.rebases[g] += 1
             self.rebased_total[g] += d
             self.rebase_stall_steps[g] = 0
@@ -535,6 +637,49 @@ class ShardedCluster:
         )
 
     # ---------------- observability ----------------
+
+    def _ingest_audit(self, starts, digests, terms, commits) -> None:
+        """Per-group digest ingestion: ledger keys are ``(group,
+        absolute index)`` with each group's own ``rebased_total``
+        correction (groups rebase independently). Runs before
+        ``_maybe_rebase`` so raw offsets and corrections agree."""
+        led = self.auditor
+        led.obs = self.obs
+        W = self.cfg.window_slots
+        for g in range(self.G):
+            reb = int(self.rebased_total[g])
+            s_l = starts[g].tolist()
+            c_l = commits[g].tolist()
+            for r in range(self.R):
+                start, commit = s_l[r], c_l[r]
+                n = commit - start
+                if n <= 0:
+                    continue
+                off = start - (commit - W)
+                led.record_window(r, start + reb,
+                                  digests[g, r, off:off + n],
+                                  terms[g, r, off:off + n],
+                                  commit + reb, group=g,
+                                  step=self.step_index)
+
+    def _record_flight(self, res, taken, tmo, burst_k: int = 1) -> None:
+        """Same contract as ``SimCluster._record_flight``, widened by
+        the group axis; arrays are copied (the sharded rebase mutates
+        ``res`` rows in place after this runs)."""
+        entry = dict(
+            step=self.step_index, burst_k=burst_k,
+            timeouts={int(g): [int(r) for r in rs]
+                      for g, rs in dict(tmo).items()},
+            rebased_total=self.rebased_total.copy(),
+            inputs=taken,
+            outputs={k: res[k].copy()
+                     for k in ("term", "role", "leader_id", "head",
+                               "apply", "commit", "end", "accepted")},
+            applied=self.applied.copy(),
+            digests=dict(start=res["audit_start"].copy(),
+                         commit=res["commit"].copy(),
+                         window=res["audit_digest"]))
+        self.flight.record(entry)
 
     def _span_recorder(self):
         from rdma_paxos_tpu.obs.spans import active_recorder
@@ -619,7 +764,9 @@ class ShardedCluster:
             groups.append(make_snapshot(**fields))
         return dict(schema=1, n_groups=self.G, n_replicas=self.R,
                     dispatches=self.dispatches,
-                    router=self.router.to_dict(), groups=groups)
+                    router=self.router.to_dict(), groups=groups,
+                    audit=(self.auditor.summary()
+                           if self.auditor is not None else None))
 
     # ---------------- leadership ----------------
 
