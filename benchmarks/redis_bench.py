@@ -85,6 +85,13 @@ def main():
     ap.add_argument("--sync-period", type=float, default=0.2,
                     help="store fdatasync cadence (durability matches "
                          "the reference's quorum-memory contract)")
+    ap.add_argument("--pipeline-depth", type=int, default=2,
+                    help="driver dispatch-pipeline depth (0/1 = fully "
+                         "serial loop)")
+    ap.add_argument("--ab-pipeline", type=int, default=2,
+                    help="rounds per variant for the pipeline on/off "
+                         "A/B (alternating best-of); emits a "
+                         "pipeline_speedup row. 0 disables")
     args = ap.parse_args()
 
     try:
@@ -115,7 +122,8 @@ def main():
         cfg, args.replicas, workdir=wd, app_ports=ports,
         timeout_cfg=TimeoutConfig(elec_timeout_low=0.5,
                                   elec_timeout_high=1.0),
-        fanout=args.fanout, sync_period=args.sync_period)
+        fanout=args.fanout, sync_period=args.sync_period,
+        pipeline=args.pipeline_depth)
     apps = []
     for r, port in enumerate(ports):
         env = dict(os.environ)
@@ -190,22 +198,47 @@ def main():
     print(f"leader: replica {lead} (redis on port {ports[lead]})")
 
     # the reference's client (run.sh:73-82), with pipelining
-    cmd = [os.path.join(SRC, "redis-benchmark"), "-p", str(ports[lead]),
-           "-t", "set", "-n", str(args.n), "-c", str(args.c),
-           "-P", str(args.P)]
-    if args.r:
-        cmd += ["-r", str(args.r)]
-    bench = subprocess.run(cmd, capture_output=True, timeout=600)
-    out = bench.stdout.decode()
+    def bench_round():
+        cmd = [os.path.join(SRC, "redis-benchmark"), "-p",
+               str(ports[lead]), "-t", "set", "-n", str(args.n),
+               "-c", str(args.c), "-P", str(args.P)]
+        if args.r:
+            cmd += ["-r", str(args.r)]
+        bench = subprocess.run(cmd, capture_output=True, timeout=600)
+        out = bench.stdout.decode()
+        rps_r = None
+        for l in out.splitlines():
+            if "requests per second" in l:
+                try:
+                    rps_r = float(l.split()[0].strip('"'))
+                except ValueError:
+                    pass
+        return rps_r, out
+
+    from benchmarks.reporting import (
+        ab_pipeline_rounds, phase_accumulate, phase_snapshot)
+
+    main_phases: dict = {}
+    pre = phase_snapshot(driver)
+    rps, out = bench_round()
+    phase_accumulate(driver, pre, main_phases)
     print("\n".join(l for l in out.splitlines()
                     if "requests per second" in l or "SET" in l))
-    rps = None
-    for l in out.splitlines():
-        if "requests per second" in l:
-            try:
-                rps = float(l.split()[0].strip('"'))
-            except ValueError:
-                pass
+
+    ab = None
+    if args.ab_pipeline > 0 and args.pipeline_depth >= 2:
+        # pipeline on/off A/B on the SAME core, same day — alternating
+        # best-of rounds (the --audit overhead methodology); the
+        # in-flight-depth counter proves the ON rounds overlapped,
+        # per-variant phase attribution
+        ab = ab_pipeline_rounds(driver, args.ab_pipeline,
+                                args.pipeline_depth,
+                                lambda: bench_round()[0])
+        if ab["off"] and ab["on"]:
+            print(f"pipeline A/B: {ab['off']:.0f} SET/s off vs "
+                  f"{ab['on']:.0f} SET/s on -> "
+                  f"{ab['on'] / ab['off']:.2f}x "
+                  f"(max in-flight dispatches {ab['depth_seen']})")
 
     # follower state equality, the run.sh FindLeader+verify analog
     time.sleep(2.0)
@@ -231,9 +264,22 @@ def main():
     emit("redis_set_ops_per_sec", rps, "ops/s",
          detail=dict(replicas=args.replicas, n=args.n, c=args.c,
                      P=args.P, r=args.r, fanout=args.fanout,
+                     pipeline_depth=args.pipeline_depth,
                      followers_equal=followers_equal,
+                     phases=dict(sorted(main_phases.items())),
                      leader_dbsize=int(lead_size.lstrip(b":") or 0)),
          obs=driver.obs)
+    if ab is not None and ab["off"] and ab["on"]:
+        emit("pipeline_speedup", round(ab["on"] / ab["off"], 3), "x",
+             detail=dict(off_ops_per_sec=ab["off"],
+                         on_ops_per_sec=ab["on"],
+                         rounds=args.ab_pipeline,
+                         n_per_round=args.n,
+                         pipeline_depth=args.pipeline_depth,
+                         max_inflight_dispatches=ab["depth_seen"],
+                         phases_on=ab["phases_on"],
+                         phases_off=ab["phases_off"]),
+             obs=driver.obs)
     if stats is not None:
         lw = (stats["loop_wall"][1] - stats["loop_wall"][0]
               if stats["loop_wall"][0] is not None else 0.0)

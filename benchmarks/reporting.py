@@ -60,3 +60,53 @@ def emit(metric: str, value=None, unit: Optional[str] = None, *,
         with open(json_path, "a") as f:
             f.write(json.dumps(row) + "\n")
     return row
+
+
+def phase_snapshot(driver) -> dict:
+    """Snapshot the driver's StepPhaseProfiler accumulator as
+    ``{phase: (n, total_us)}`` — the baseline for a per-window delta."""
+    return {p: (a[0], a[1])
+            for p, a in list(driver._phase_prof.acc.items())}
+
+
+def phase_accumulate(driver, pre: dict, agg: dict) -> dict:
+    """Fold the accumulator's delta since ``pre`` into ``agg``
+    (``{phase: {n, total_us}}``). The profiler accumulator is global,
+    so emitting it raw would blend measurement windows — every A/B
+    variant must carry only its own rounds' attribution."""
+    for p, (n1, t1) in phase_snapshot(driver).items():
+        n0, t0 = pre.get(p, (0, 0.0))
+        row = agg.setdefault(p, dict(n=0, total_us=0.0))
+        row["n"] += n1 - n0
+        row["total_us"] = round(row["total_us"] + (t1 - t0), 1)
+    return agg
+
+
+def ab_pipeline_rounds(driver, rounds: int, depth: int, run_once) -> dict:
+    """Alternating best-of pipeline on/off A/B on the same core (the
+    ``--audit`` overhead methodology, shared by run_bench and
+    redis_bench). ``run_once()`` runs one round and returns ops/s (or
+    None/0 for a failed round — skipped in the best-of). The in-flight
+    depth counter is reset per ON round so ``depth_seen`` proves the
+    ON rounds really overlapped dispatches. Restores
+    ``driver.pipeline = depth`` before returning."""
+    ab = {"off": 0.0, "on": 0.0}
+    phases = {"off": {}, "on": {}}
+    depth_seen = 0
+    for _ in range(rounds):
+        for variant, d in (("off", 0), ("on", depth)):
+            driver.pipeline = d
+            driver.cluster.max_inflight_dispatches = 0
+            pre = phase_snapshot(driver)
+            ops = run_once()
+            phase_accumulate(driver, pre, phases[variant])
+            if ops:
+                ab[variant] = max(ab[variant], float(ops))
+            if variant == "on":
+                depth_seen = max(
+                    depth_seen,
+                    int(driver.cluster.max_inflight_dispatches))
+    driver.pipeline = depth
+    return dict(off=ab["off"], on=ab["on"], depth_seen=depth_seen,
+                phases_on=dict(sorted(phases["on"].items())),
+                phases_off=dict(sorted(phases["off"].items())))

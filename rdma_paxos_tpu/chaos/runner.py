@@ -210,7 +210,7 @@ class NemesisRunner:
                  artifact_path: Optional[str] = None,
                  skip_incompatible_faults: bool = False,
                  obs: Optional[Observability] = None,
-                 audit: bool = True):
+                 audit: bool = True, pipeline: int = 0):
         self.cfg = cfg or DEFAULT_KV_CFG
         self.R = int(n_replicas)
         self.seed = int(seed)
@@ -267,6 +267,15 @@ class NemesisRunner:
                                   **self.workload_opts)
         self.n_clients, self.n_keys = n_clients, n_keys
         self.fanout = fanout
+        # pipeline >= 2: drive the cluster the way the pipelined
+        # driver does — up to that many dispatches in flight on the
+        # stable-leader path (begin_step, ring-room checked), draining
+        # to the serial step whenever a fault event is due, a timer
+        # fires, or the leader is unknown. The chaos verdict must stay
+        # green: pipelining is a pure latency transform (the pinning
+        # tests in tests/test_pipeline.py assert bit-identity too).
+        self.pipeline = int(pipeline)
+        self._pl: List[tuple] = []  # (logical step id, ticket) in flight
 
     # ------------------------------------------------------------------
 
@@ -282,18 +291,10 @@ class NemesisRunner:
             n_clients=self.n_clients, n_keys=self.n_keys,
             workload_opts=self.workload_opts)
 
-    def _one_step(self, t: int, leader: int,
-                  violations: List[dict]) -> int:
-        self.history.set_clock(t)
-        fired = self.schedule.apply(t, self.cluster, self.link,
-                                    timers=self.timers, hard=self.hard,
-                                    kvs=self.kv)
-        for ev in fired:
-            if ev["op"] == "restart":
-                self.invariants.reset_replica(ev["replica"])
-        self.workload.issue(t, leader, self.link.down)
-        timeouts = self.timers.fire(self.link.down)
-        res = self.cluster.step(timeouts=timeouts)
+    def _observe_res(self, t: int, res,
+                     violations: List[dict]) -> int:
+        """Post-step observation rules for one finished step's outputs
+        (shared by the serial and pipelined drives)."""
         self.hard.observe(res)
         self.timers.observe(res)
         try:
@@ -307,6 +308,72 @@ class NemesisRunner:
         self.workload.observe(t, leader)
         return leader
 
+    def _finish_one(self, violations: List[dict]) -> int:
+        t, ticket = self._pl.pop(0)
+        res = self.cluster.finish(ticket)
+        return self._observe_res(t, res, violations)
+
+    def _drain(self, leader: int, violations: List[dict]) -> int:
+        while self._pl:
+            leader = self._finish_one(violations)
+        return leader
+
+    def _pipeline_eligible(self, t: int, leader: int) -> bool:
+        """The stable-leader dispatch-without-finishing window: no
+        fault event due this step, a known leader, an initialized
+        cluster. Ring room is checked separately (``_room_ok``) AFTER
+        the workload issues this step's entries — a pre-issue check
+        would not cover them."""
+        if self.pipeline < 2 or leader < 0:
+            return False
+        c = self.cluster
+        return c.last is not None and not self.schedule.due(t)
+
+    def _room_ok(self) -> bool:
+        """Ring room for the WHOLE pending backlog (including entries
+        the workload just issued), so a shortfall requeue — which
+        would reorder against in-flight dispatches — is impossible;
+        elections cannot start in flight because in-flight dispatches
+        carried no timeouts."""
+        c = self.cluster
+        reserved = c.reserved_appends()
+        last = c.last
+        return all(
+            len(c.pending[r]) + int(reserved[r])
+            <= (self.cfg.n_slots - 1) - (int(last["end"][r])
+                                         - int(last["head"][r]))
+            for r in range(self.R))
+
+    def _one_step(self, t: int, leader: int,
+                  violations: List[dict]) -> int:
+        self.history.set_clock(t)
+        if self._pipeline_eligible(t, leader):
+            self.workload.issue(t, leader, self.link.down)
+            timeouts = self.timers.fire(self.link.down)
+            if not timeouts and self._room_ok():
+                self._pl.append((t, self.cluster.begin_step()))
+                if len(self._pl) >= self.pipeline:
+                    leader = self._finish_one(violations)
+                return leader
+            # a timer fired (or the ring can no longer cover the
+            # issued backlog): drain and run the serial step
+            leader = self._drain(leader, violations)
+            res = self.cluster.step(timeouts=timeouts)
+            return self._observe_res(t, res, violations)
+        # serial path: fault events mutate cluster/link state and must
+        # never run under in-flight dispatches
+        leader = self._drain(leader, violations)
+        fired = self.schedule.apply(t, self.cluster, self.link,
+                                    timers=self.timers, hard=self.hard,
+                                    kvs=self.kv)
+        for ev in fired:
+            if ev["op"] == "restart":
+                self.invariants.reset_replica(ev["replica"])
+        self.workload.issue(t, leader, self.link.down)
+        timeouts = self.timers.fire(self.link.down)
+        res = self.cluster.step(timeouts=timeouts)
+        return self._observe_res(t, res, violations)
+
     def run(self) -> Dict:
         """Execute the schedule, settle, check. Returns the verdict
         dict (deterministic for a given seed: no wall-clock fields);
@@ -317,6 +384,9 @@ class NemesisRunner:
             leader = self._one_step(t, leader, violations)
             if violations:
                 break
+        # drain any in-flight pipelined dispatches before host-side
+        # state surgery (restarts) or the convergence sweep
+        leader = self._drain(leader, violations)
         # settle: clear faults, revive the dead, let the cluster
         # converge so the convergence invariant and pending ops resolve
         self.history.set_clock(self.steps)
@@ -331,6 +401,7 @@ class NemesisRunner:
                 leader = self._one_step(t, leader, violations)
                 if violations:
                     break
+            leader = self._drain(leader, violations)
         self.workload.finish()
         if not violations:
             try:

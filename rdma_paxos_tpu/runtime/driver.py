@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import collections
 import os
+import queue as _queue
 import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -110,7 +111,7 @@ class ClusterDriver:
                  health_period: float = 0.5, link_model=None,
                  fence: bool = False, audit: bool = False,
                  alert_rules: Optional[Sequence[dict]] = None,
-                 alert_period: float = 0.25):
+                 alert_period: float = 0.25, pipeline: int = 2):
         self.cfg = cfg
         self.sync_period = sync_period
         self._workdir = workdir
@@ -167,8 +168,8 @@ class ClusterDriver:
         # continuous proof that all R replicas hold bit-identical
         # committed state, with a bounded evidence ring dumped when
         # the digest-mismatch page fires
-        self.cluster = SimCluster(cfg, n_replicas, group_size, mode=mode,
-                                  fanout=fanout, audit=audit)
+        self.cluster = self._make_cluster(cfg, n_replicas, group_size,
+                                          mode, fanout, audit)
         self.cluster.obs = self.obs
         self.cluster.profiler = self._phase_prof
         # SLO alert rules (obs/alerts.py) evaluated on a cadence from
@@ -201,6 +202,11 @@ class ClusterDriver:
         self.fail_threshold = fail_threshold
         self.fail_count = np.zeros(n_replicas, np.int64)
         self._mm = MembershipManager(self.cluster)
+        # last known membership view (device-state reads are unsafe —
+        # and pipeline-serializing — while dispatches are in flight;
+        # see _member_view_cached)
+        self._member_cur = dict(bitmask_new=(1 << n_replicas) - 1,
+                                epoch=0, cid_state=0)
         # (phase, new_mask, epoch, steps_left) — steps_left bounds a change
         # wedged by leader churn losing the CONFIG entry; on expiry the
         # phase resets so eviction/request can be re-issued
@@ -243,6 +249,31 @@ class ClusterDriver:
         # app itself needs (the reference's libev loop is fd-driven for
         # the same reason, dare_server.c:1004-1125)
         self._wake = threading.Event()
+        # pipelined dispatch (the perf hot path): with pipeline >= 2 the
+        # run loop keeps up to ``pipeline`` device dispatches in flight
+        # — the dispatch thread encodes batch k+1 while batch k runs on
+        # the device, and a dedicated READBACK thread blocks on outputs
+        # and runs all post-step host work (requeue, replay, acks,
+        # observability), so device_sync never serializes the enqueue
+        # path. Election timeouts, admin requests (recover/reset/ckpt),
+        # rebase drains, and recovery always drain the pipeline first
+        # and run through the serial step() — pipelining is engaged
+        # only on the stable-leader traffic path, where it is a pure
+        # latency/throughput transform (the commit stream and ack
+        # stream are bit-identical to the serial driver; tests pin it).
+        # Mutable at runtime (A/B benches flip it between rounds).
+        self.pipeline = max(int(pipeline), 0)
+        self._pl_cv = threading.Condition()
+        self._pl_pending = 0        # dispatched, not yet post-stepped
+        self._pl_queue: _queue.Queue = _queue.Queue()
+        self._rb_thread: Optional[threading.Thread] = None
+
+    def _make_cluster(self, cfg, n_replicas, group_size, mode, fanout,
+                      audit):
+        """Engine factory (the sharded driver subclass overrides this
+        to serve a multi-group ShardedCluster through the same loop)."""
+        return SimCluster(cfg, n_replicas, group_size, mode=mode,
+                          fanout=fanout, audit=audit)
 
     # ------------------------------------------------------------------
     # shim event intake (called from proxy link threads)
@@ -289,7 +320,7 @@ class ClusterDriver:
                         # a dirty (mis-speculated) app must not serve
                         # clients — not even stale local reads
                         return -1
-                    if self._leader_view != r:
+                    if not self._accepts_clients(r):
                         return None
                     if r in self.stepped_down:
                         # a stepped-down (majority-less) leader accepts
@@ -318,7 +349,7 @@ class ClusterDriver:
                     # the committed stream
                     rt.replicated_conns.discard(conn_id)
                     return -1
-                elif self._leader_view != r:
+                elif not self._accepts_clients(r):
                     # a REPLICATED session must never silently downgrade
                     # to unreplicated service after deposition: sever it
                     # so the client reconnects to the current leader
@@ -328,32 +359,53 @@ class ClusterDriver:
                     return refuse_send()
                 if etype == int(EntryType.CLOSE):
                     rt.replicated_conns.discard(conn_id)
-                frags = (fragment(payload, self.cfg.slot_bytes)
-                         if etype == int(EntryType.SEND) else [payload])
-                ev = PendingEvent(EntryType(etype), conn_id, payload)
-                for f in frags:
-                    rt.submit_seq += 1
-                    self._submitq[r].append((etype, conn_id, f,
-                                             rt.submit_seq))
-                rt.inflight.append((ev, rt.submit_seq))
-                self.obs.metrics.inc("proxy_events_total", replica=r)
-                self.obs.trace.record(obs_trace.PROXY_ENQUEUE,
-                                      replica=r, etype=etype,
-                                      conn=conn_id, frags=len(frags),
-                                      submit_seq=rt.submit_seq)
-                # causal span birth: keyed (conn, final fragment seq) —
-                # the exact pair the ack-release path matches on
-                self.obs.spans.begin(conn_id, rt.submit_seq, r)
-                self._wake.set()
-                return ev
+                return self._enqueue_locked(r, rt, etype, conn_id,
+                                            payload)
         return on_event
+
+    def _accepts_clients(self, r: int) -> bool:
+        """Client-session admission: the single-group driver serves
+        replicated sessions on the leader only (non-leaders give stale
+        local reads, the reference's follower semantics). The sharded
+        driver overrides this — every replica is a serving front-end
+        demuxing onto the G group leaders."""
+        return self._leader_view == r
+
+    def _enqueue_locked(self, r: int, rt: _ReplicaRuntime, etype: int,
+                        conn_id: int, payload: bytes):
+        """Admit one gate-passed replicated event: fragment, stamp
+        sequence numbers, queue for the next dispatch, and park the
+        blocked app thread's PendingEvent (caller holds ``_lock``).
+        The sharded driver overrides this to pin the connection to its
+        key-routed consensus group first."""
+        frags = (fragment(payload, self.cfg.slot_bytes)
+                 if etype == int(EntryType.SEND) else [payload])
+        ev = PendingEvent(EntryType(etype), conn_id, payload)
+        for f in frags:
+            rt.submit_seq += 1
+            self._submitq[r].append((etype, conn_id, f,
+                                     rt.submit_seq))
+        rt.inflight.append((ev, rt.submit_seq))
+        self.obs.metrics.inc("proxy_events_total", replica=r)
+        self.obs.trace.record(obs_trace.PROXY_ENQUEUE,
+                              replica=r, etype=etype,
+                              conn=conn_id, frags=len(frags),
+                              submit_seq=rt.submit_seq)
+        # causal span birth: keyed (conn, final fragment seq) —
+        # the exact pair the ack-release path matches on
+        self.obs.spans.begin(conn_id, rt.submit_seq, r)
+        self._wake.set()
+        return ev
 
     # ------------------------------------------------------------------
     # the polling loop
     # ------------------------------------------------------------------
 
-    def step(self) -> Dict:
-        """One host-loop iteration (public for deterministic tests)."""
+    def _drain_admin(self) -> None:
+        """Serve pending operator requests (recovery / app reset /
+        checkpoint) — they execute on the stepping thread so they never
+        race it over cluster state, and only with the dispatch pipeline
+        fully drained."""
         req = self._recover_req
         if req is not None:
             self._recover_req = None
@@ -384,12 +436,25 @@ class ClusterDriver:
                 box.append(exc)
             finally:
                 done.set()
-        with self._lock:
+
+    def _pump_submitq(self) -> None:
+        """Move intake rows into the engine's pending queues. Holds the
+        engine's host lock too: the pipelined readback thread requeues
+        ring-full shortfalls into the same lists concurrently."""
+        with self._lock, self.cluster._host_lock:
             for r in range(self.R):
                 for etype, conn, frag, seq in self._submitq[r]:
                     self.cluster.submit(r, frag, EntryType(etype),
                                         conn=conn, req_id=seq)
                 self._submitq[r].clear()
+
+    def step(self) -> Dict:
+        """One host-loop iteration (public for deterministic tests).
+        Serial: dispatch + readback fused — the pipelined run loop
+        splits the same work into begin_* on the dispatch thread and
+        ``_post_step`` on the readback thread."""
+        self._drain_admin()
+        self._pump_submitq()
 
         # a flagged (force-pruned) leader never heals on its own: it
         # acks windows and heartbeats normally, so nothing deposes it,
@@ -407,14 +472,16 @@ class ClusterDriver:
             if healthy:
                 depose = min(healthy)
 
-        # deep submit queue + known leader: drain through a multi-step
-        # burst (one dispatch for up to K_TIERS[-1] protocol steps; no
+        # pending work + known leader: drain through a multi-step burst
+        # (one dispatch fuses up to K_TIERS[-1] protocol steps; no
         # election timeouts can fire inside — each burst step carries the
-        # heartbeat, so follower timers are beaten right after)
+        # heartbeat, so follower timers are beaten right after). Bursts
+        # are the DEFAULT e2e path — any backlog rides a fused dispatch;
+        # the single-step path serves elections, deposes, and idle
+        # heartbeats.
         if (depose < 0
                 and self._leader_view >= 0 and self.cluster.last is not None
-                and max(len(q) for q in self.cluster.pending)
-                > self.cfg.batch_slots):
+                and self._backlog()):
             self._timer_obs.start("device_step")
             res = self.cluster.step_burst()
             self._timer_obs.stop("device_step")
@@ -445,7 +512,13 @@ class ClusterDriver:
             self._timer_obs.start("device_step")
             res = self.cluster.step(timeouts=timeouts)
             self._timer_obs.stop("device_step")
+        return self._post_step(res)
 
+    def _backlog(self) -> int:
+        """Entries awaiting dispatch in the engine's pending queues."""
+        return max(len(q) for q in self.cluster.pending)
+
+    def _update_leader_view(self, res) -> None:
         with self._lock:
             # multiple self-claimed leaders can coexist transiently (an
             # isolated deposed leader cannot hear the higher term); the
@@ -454,6 +527,15 @@ class ClusterDriver:
             claims = [(int(res["term"][r]), r) for r in range(self.R)
                       if res["role"][r] == int(Role.LEADER)]
             self._leader_view = max(claims)[1] if claims else -1
+
+    def _post_step(self, res) -> Dict:
+        """Every post-readback host rule for one step's outputs: leader
+        view, durable election state, timer beats, store/replay/ack
+        release, detectors, recovery drive, and observability export.
+        Serial ``step()`` runs it inline; the pipelined loop runs it on
+        the READBACK thread, so none of this work — observability
+        included — can serialize the dispatch path it measures."""
+        self._update_leader_view(res)
 
         for r, rt in enumerate(self.runtimes):
             if rt.hard is not None:
@@ -491,6 +573,11 @@ class ClusterDriver:
         # rejoin collapsed into one step (one per iteration)
         if (self.cluster.need_recovery
                 and self._leader_view >= 0
+                # never under in-flight dispatches: snapshot install
+                # rewrites cluster state the pipeline is still feeding
+                # (the dispatch loop sees need_recovery and drains, so
+                # the next drained iteration takes this branch)
+                and not self.cluster._tickets
                 # the donor is the leader: it must itself be healthy —
                 # a flagged leader's host store is frozen, so its
                 # snapshot would silently drop acked writes; wait for
@@ -721,12 +808,24 @@ class ClusterDriver:
                 with self._lock:
                     self._fail_inflight_locked(rt, "step-down")
 
+    def _member_view_cached(self, lead: int) -> dict:
+        """The current config view (bitmask/epoch/cid_state), refreshed
+        from device state only while NOTHING is in flight (a device
+        read under in-flight dispatches both races state donation and
+        serializes the pipeline). Config changes drain the pipeline
+        (see _pipeline_ready), so the cache is stale at most for the
+        duration of one drained transition."""
+        with self.cluster._host_lock:
+            if not self.cluster._tickets:
+                self._member_cur = self._mm.current(lead)
+        return self._member_cur
+
     def _failure_detector(self, res) -> None:
         lead = self._leader_view
         if lead < 0:
             self.fail_count[:] = 0
             return
-        cur = self._mm.current(lead)
+        cur = self._member_view_cached(lead)
         mask = cur["bitmask_new"]
         acked = res["peer_acked"][lead]
         for r in range(self.R):
@@ -764,43 +863,56 @@ class ClusterDriver:
         MembershipManager.change for use inside the polling loop."""
         if self._config_phase is None:
             return
-        phase, new_mask, epoch, ttl = self._config_phase
-        if ttl <= 0:
-            # CONFIG entry lost (e.g. leader deposed before it replicated):
-            # abandon so the failure detector / operator can resubmit
-            self._config_phase = None
-            self.config_changes_abandoned += 1
-            self.obs.metrics.inc("config_changes_abandoned_total")
-            self.obs.trace.record(obs_trace.MEMBERSHIP_CHANGE,
-                                  phase="abandoned", new_mask=new_mask,
-                                  epoch=epoch)
-            return
-        self._config_phase = (phase, new_mask, epoch, ttl - 1)
-        lead = self._leader_view
-        if lead < 0:
-            return
-        cur = self._mm.current(lead)
-        last = self.cluster.last
-        committed = (last is not None and
-                     int(last["commit"][lead]) >= int(last["end"][lead]))
-        if phase == "transit":
-            if (cur["epoch"] >= epoch
-                    and cur["cid_state"] == int(ConfigState.TRANSIT)
-                    and committed):
-                self._mm.submit_stable(lead, new_mask, epoch + 1)
-                self._config_phase = ("stable", new_mask, epoch + 1, ttl)
-                self.obs.trace.record(obs_trace.MEMBERSHIP_CHANGE,
-                                      phase="stable_submitted",
-                                      new_mask=new_mask,
-                                      epoch=epoch + 1)
-        elif phase == "stable":
-            if (cur["epoch"] >= epoch
-                    and cur["cid_state"] == int(ConfigState.STABLE)):
+        # under pipelining this runs on the readback thread: in-flight
+        # dispatches may have donated the device buffers _mm.current
+        # reads, and a concurrent batch take would race submit_stable.
+        # The engine host lock brackets every dispatch, so holding it
+        # with tickets empty proves no donation can land mid-read —
+        # and _pipeline_ready sees the phase and drains, so a deferred
+        # iteration drives the change serially (TTL untouched).
+        with self.cluster._host_lock:
+            if self.cluster._tickets:
+                return
+            phase, new_mask, epoch, ttl = self._config_phase
+            if ttl <= 0:
+                # CONFIG entry lost (e.g. leader deposed before it
+                # replicated): abandon so the failure detector /
+                # operator can resubmit
                 self._config_phase = None
-                self.obs.metrics.inc("config_changes_total")
+                self.config_changes_abandoned += 1
+                self.obs.metrics.inc("config_changes_abandoned_total")
                 self.obs.trace.record(obs_trace.MEMBERSHIP_CHANGE,
-                                      phase="complete",
+                                      phase="abandoned",
                                       new_mask=new_mask, epoch=epoch)
+                return
+            self._config_phase = (phase, new_mask, epoch, ttl - 1)
+            lead = self._leader_view
+            if lead < 0:
+                return
+            cur = self._mm.current(lead)
+            last = self.cluster.last
+            committed = (last is not None and
+                         int(last["commit"][lead])
+                         >= int(last["end"][lead]))
+            if phase == "transit":
+                if (cur["epoch"] >= epoch
+                        and cur["cid_state"] == int(ConfigState.TRANSIT)
+                        and committed):
+                    self._mm.submit_stable(lead, new_mask, epoch + 1)
+                    self._config_phase = ("stable", new_mask,
+                                          epoch + 1, ttl)
+                    self.obs.trace.record(obs_trace.MEMBERSHIP_CHANGE,
+                                          phase="stable_submitted",
+                                          new_mask=new_mask,
+                                          epoch=epoch + 1)
+            elif phase == "stable":
+                if (cur["epoch"] >= epoch
+                        and cur["cid_state"] == int(ConfigState.STABLE)):
+                    self._config_phase = None
+                    self.obs.metrics.inc("config_changes_total")
+                    self.obs.trace.record(obs_trace.MEMBERSHIP_CHANGE,
+                                          phase="complete",
+                                          new_mask=new_mask, epoch=epoch)
 
     def request_membership(self, new_mask: int) -> None:
         """Operator API: start a two-phase change to ``new_mask`` (join /
@@ -1132,50 +1244,190 @@ class ClusterDriver:
     # lifecycle
     # ------------------------------------------------------------------
 
+    def _handle_loop_crash(self, exc: BaseException) -> None:
+        """A raised step must never silently kill the poll thread with
+        app threads parked on commit waits: record it, fail every
+        blocked event so the apps sever/retry, and stop the loop."""
+        import traceback
+        self.loop_error = exc
+        traceback.print_exc()
+        self.obs.metrics.inc("loop_errors_total")
+        with self._lock:
+            for rt in self.runtimes:
+                self._fail_inflight_locked(rt, "poll-loop crash")
+        if self._workdir is not None:
+            # post-mortem: persist the protocol trace ring next to the
+            # replica logs
+            try:
+                self.obs.trace.dump_on_failure(
+                    os.path.join(self._workdir, "trace_dump.json"),
+                    reason=f"poll-loop crash: {exc!r}")
+            except OSError:
+                pass
+
+    def _busy(self) -> bool:
+        with self._lock:
+            return bool(any(self._submitq)
+                        or any(len(q) for q in self.cluster.pending)
+                        or self._waiter_count())
+
+    def _waiter_count(self) -> int:
+        """Blocked commit waiters across replicas (caller holds
+        ``_lock``); the sharded driver counts its per-group deques."""
+        return sum(len(rt.inflight) for rt in self.runtimes)
+
+    def _pipeline_ready(self) -> bool:
+        """True iff the next iteration may DISPATCH WITHOUT FINISHING —
+        the stable-leader traffic path where pipelining is a pure
+        latency/throughput transform. Everything else (elections,
+        admin requests, recovery, rebase drains, idle heartbeats)
+        drains the pipeline and runs the serial ``step()``."""
+        if (self._recover_req is not None or self._reset_req is not None
+                or self._ckpt_req is not None):
+            return False
+        c = self.cluster
+        if c.last is None or self._leader_view < 0:
+            return False
+        if c.need_recovery or self.stepped_down:
+            return False
+        # a membership change in flight polls device-side config state
+        # every step — drive it through drained serial steps
+        if self._config_phase is not None:
+            return False
+        # stop dispatching once the i32-rollover threshold is crossed:
+        # the rebase is deferred until the pipeline drains, and the
+        # headroom margin covers only boundedly many in-flight bursts
+        if int(c.last["end"].max()) >= self.cfg.rebase_threshold:
+            return False
+        # pipelining pays off only while APPEND BATCHES flow (encode
+        # k+1 while k runs); with just blocked waiters and an empty
+        # queue the serial loop acks a commit one dispatch sooner —
+        # keeping the latency-bound regime on the serial path is what
+        # makes pipelining a pure win, not a latency trade
+        with self._lock:
+            if not (any(self._submitq) or self._backlog()):
+                return False
+        # any expired follower election timer needs the serial path
+        # (bursts and pipelined steps never fire timeouts)
+        last = c.last
+        for r, rt in enumerate(self.runtimes):
+            if (not self._role_is_leader(last, r)
+                    and rt.timer.expired()):
+                return False
+        return True
+
+    def _role_is_leader(self, res, r: int) -> bool:
+        return bool(res["role"][r] == int(Role.LEADER))
+
+    def _drain_pipeline(self) -> bool:
+        """Block until the readback thread retired every in-flight
+        ticket (device outputs read AND post-step host rules run).
+        True when drained; False when the loop died."""
+        with self._pl_cv:
+            while self._pl_pending:
+                if self.loop_error is not None:
+                    return False
+                if (self._rb_thread is not None
+                        and not self._rb_thread.is_alive()):
+                    return False
+                self._pl_cv.wait(timeout=0.05)
+        return self.loop_error is None
+
+    def _readback_loop(self) -> None:
+        """Consumer half of the pipelined driver: finish tickets in
+        dispatch (FIFO) order and run every post-step host rule —
+        including observability export — OFF the dispatch path."""
+        while True:
+            ticket = self._pl_queue.get()
+            if ticket is None:
+                return
+            try:
+                res = self.cluster.finish(ticket)
+                self._post_step(res)
+            except Exception as exc:  # noqa: BLE001
+                self._handle_loop_crash(exc)
+                with self._pl_cv:
+                    self._pl_pending = 0
+                    self._pl_cv.notify_all()
+                return
+            with self._pl_cv:
+                self._pl_pending -= 1
+                self._pl_cv.notify_all()
+
+    def _dispatch_loop(self, period: float) -> None:
+        while not self._stop.is_set():
+            if self.loop_error is not None:
+                return
+            if not (self.pipeline >= 2 and self._pipeline_ready()):
+                # serial iteration (elections / admin / recovery /
+                # rebase / idle heartbeat): drain first — the engine's
+                # FIFO finish contract forbids a fused step() while
+                # tickets are in flight
+                if not self._drain_pipeline():
+                    return
+                if self._stop.is_set():
+                    return
+                try:
+                    self.step()
+                except Exception as exc:  # noqa: BLE001
+                    self._handle_loop_crash(exc)
+                    return
+                if not self._busy() and period:
+                    self._wake.wait(timeout=period)
+                self._wake.clear()
+                continue
+            # ---- pipelined fast path: encode + dispatch only ----
+            with self._pl_cv:
+                if self._pl_pending >= self.pipeline:
+                    self._pl_cv.wait(timeout=0.05)
+                    continue
+            self._pump_submitq()
+            try:
+                self._timer_obs.start("device_step")
+                if self._backlog():
+                    ticket = self.cluster.begin_burst()
+                else:
+                    # waiters with empty queues: quorum/commit trails
+                    # the last append by a step — advance it (no batch
+                    # take: pipelined appends ride capacity-clamped
+                    # bursts only, so shortfall requeues cannot reorder
+                    # against in-flight dispatches)
+                    ticket = self.cluster.begin_step(take_batch=False)
+                self._timer_obs.stop("device_step")
+            except Exception as exc:  # noqa: BLE001
+                self._handle_loop_crash(exc)
+                return
+            with self._pl_cv:
+                self._pl_pending += 1
+            self._pl_queue.put(ticket)
+
     def run(self, period: float = 0.0) -> None:
-        """Run the polling loop in a background thread. While client work
+        """Run the polling loop in background threads. While client work
         is pending or blocked app threads await commit, the loop
         free-runs (the reference's busy commit loop). When idle it
         PARKS for up to ``period`` seconds (the hb_period cadence — each
         step carries the heartbeat, so ``period`` must stay well under
         the election timeout) and wakes INSTANTLY when a link thread
         hands it an event — on a shared-core host, idle free-running
-        would steal the CPU the app itself needs."""
+        would steal the CPU the app itself needs.
+
+        With ``pipeline >= 2`` (the default) the stable-leader traffic
+        path runs DOUBLE-BUFFERED: the dispatch thread encodes and
+        enqueues batch k+1 while batch k is still running on the
+        device, and the readback thread blocks on outputs and runs the
+        post-step host rules (requeue, replay, acks, observability) —
+        ``device_sync`` never blocks the enqueue path. ``pipeline=0``
+        (or 1) restores the fully serial loop."""
+        self._pl_pending = 0
+        self._rb_thread = threading.Thread(target=self._readback_loop,
+                                           daemon=True)
+        self._rb_thread.start()
+
         def loop():
-            while not self._stop.is_set():
-                try:
-                    self.step()
-                except Exception as exc:  # noqa: BLE001
-                    # a raised step must never silently kill the poll
-                    # thread with app threads parked on commit waits:
-                    # record it, fail every blocked event so the apps
-                    # sever/retry, and stop the loop
-                    import traceback
-                    self.loop_error = exc
-                    traceback.print_exc()
-                    self.obs.metrics.inc("loop_errors_total")
-                    with self._lock:
-                        for rt in self.runtimes:
-                            self._fail_inflight_locked(
-                                rt, "poll-loop crash")
-                    if self._workdir is not None:
-                        # post-mortem: persist the protocol trace ring
-                        # next to the replica logs
-                        try:
-                            self.obs.trace.dump_on_failure(
-                                os.path.join(self._workdir,
-                                             "trace_dump.json"),
-                                reason=f"poll-loop crash: {exc!r}")
-                        except OSError:
-                            pass
-                    return
-                with self._lock:
-                    busy = (any(self._submitq)
-                            or any(len(q) for q in self.cluster.pending)
-                            or any(rt.inflight for rt in self.runtimes))
-                if not busy and period:
-                    self._wake.wait(timeout=period)
-                self._wake.clear()
+            try:
+                self._dispatch_loop(period)
+            finally:
+                self._pl_queue.put(None)     # retire the readback side
         self._thread = threading.Thread(target=loop, daemon=True)
         self._thread.start()
 
@@ -1192,6 +1444,8 @@ class ClusterDriver:
             return
         self._stop.set()
         self._wake.set()
+        with self._pl_cv:
+            self._pl_cv.notify_all()
         if self._thread is not None:
             self._thread.join(timeout=join_timeout)
             if self._thread.is_alive():
@@ -1226,6 +1480,9 @@ class ClusterDriver:
                     "released %d inflight waiters with -1; leaving "
                     "native handles open" % (join_timeout, n))
                 return
+        if self._rb_thread is not None:
+            self._pl_queue.put(None)
+            self._rb_thread.join(timeout=join_timeout)
         # release commit waiters that were already inflight at stop —
         # nothing will ever step again, so they must fail, not hang
         with self._lock:

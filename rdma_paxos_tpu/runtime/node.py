@@ -298,7 +298,13 @@ class NodeDaemon:
         if not self.burst_enabled:
             hint = 0
         k_needed = -(-hint // B) if hint > 0 else 0
-        if k_needed > 1:
+        # fused bursts are the DEFAULT e2e path: ANY gathered backlog
+        # rides the one fixed-K burst program (shallow content padded
+        # with empty steps), so per-dispatch overhead is amortized the
+        # moment traffic exists — the single-step path serves only
+        # idle heartbeats and election iterations. The decision derives
+        # ONLY from the gathered hint, so every host agrees.
+        if k_needed >= 1:
             # ONE fixed burst tier: every distinct K is a separate
             # multi-process shard_map compile (~seconds, and the
             # persistent cache does not serve these programs), so the

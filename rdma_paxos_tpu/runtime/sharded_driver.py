@@ -1,0 +1,498 @@
+"""ShardedClusterDriver — the e2e data plane over G consensus groups.
+
+``ClusterDriver`` serves one consensus group: every client session rides
+the single leader. This driver serves a :class:`~rdma_paxos_tpu.shard.
+cluster.ShardedCluster` through the SAME polling/pipelining loop — the
+multi-group scaling ``benchmarks/shard_bench.py`` demonstrates in sim,
+threaded through the real proxy/shim/app path:
+
+  * **Every replica is a serving front-end.** Clients connect to any
+    replica's app; the shim events flow into that replica's proxy as
+    usual. There is no single cluster leader — each of the G groups
+    elects its own, spread across the R replicas.
+  * **Connections are routed by key prefix.** A shim connection is
+    pinned to the consensus group that owns the KEY PREFIX of its first
+    replicated SEND (``KeyRouter.group_of``; the prefix is the key up
+    to the first ``-``/``:``/``.`` delimiter — RESP arrays and inline
+    commands both parse). All of the connection's traffic then rides
+    that one group's log, so per-key linearizability holds as long as
+    clients keep a connection's keys within one routing unit — the
+    same client contract as Redis Cluster hash slots.
+  * **CONNECT is held, not blocked.** The group is unknown until the
+    first SEND names a key, so the CONNECT entry is held and acked
+    immediately (it carries no data); when the first SEND pins group g
+    the CONNECT is submitted ahead of it into g's log — FIFO within
+    the group, so every replica replays CONNECT before the data, and
+    an acked SEND transitively proves its CONNECT committed.
+  * **Acks demux per group.** Commit waiters are tracked per
+    ``(replica, group)`` FIFO; group g's commit stream releases only
+    g's waiters, so groups committing at different rates can never
+    reorder or cross-release acks.
+
+The pipelined dispatch loop (double-buffered ``begin_*``/``finish``,
+readback thread) is inherited unchanged — the engines share one
+ticket contract. Operator surfaces that are single-group by design
+(membership change, snapshot recovery, app checkpoints, step-down
+detection) are not supported in sharded mode and raise; ROADMAP item 4
+(elastic resharding) is where they return.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from typing import Dict, List, Optional
+
+from rdma_paxos_tpu.config import LogConfig
+from rdma_paxos_tpu.consensus.log import EntryType
+from rdma_paxos_tpu.consensus.state import Role
+from rdma_paxos_tpu.obs import trace as obs_trace
+from rdma_paxos_tpu.obs.health import make_snapshot
+from rdma_paxos_tpu.obs.metrics import LATENCY_BUCKETS_S
+from rdma_paxos_tpu.proxy.proxy import PendingEvent
+from rdma_paxos_tpu.runtime.driver import ClusterDriver, conn_origin
+from rdma_paxos_tpu.runtime.timers import ElectionTimer
+from rdma_paxos_tpu.shard.cluster import ShardedCluster
+from rdma_paxos_tpu.shard.router import KeyRouter
+from rdma_paxos_tpu.utils.codec import fragment
+
+PREFIX_DELIMS = (b"-", b":", b".")
+
+
+def key_prefix_of(payload: bytes) -> bytes:
+    """The routing key prefix of a replicated SEND payload: the first
+    command's key, truncated at the first prefix delimiter. Parses both
+    RESP arrays (``*3\\r\\n$3\\r\\nSET\\r\\n$5\\r\\nkey-1...``) and
+    inline/space-separated commands (``SET key-1 v1``). A payload with
+    no recognizable key routes by the empty prefix (a legal router
+    input) — deterministic, just unspread."""
+    key = b""
+    if payload[:1] == b"*":
+        parts = payload.split(b"\r\n", 5)
+        if len(parts) >= 5:
+            key = parts[4]
+    else:
+        toks = payload.split(None, 2)
+        if len(toks) >= 2:
+            key = toks[1]
+        elif toks:
+            key = toks[0]
+    # truncate at the FIRST-occurring delimiter (not the first in
+    # PREFIX_DELIMS order): b"user.1-x" routes as b"user", never
+    # b"user.1" — anything else would split a documented routing unit
+    cut = len(key)
+    for d in PREFIX_DELIMS:
+        i = key.find(d, 0, cut)
+        if i > 0:
+            cut = i
+    return key[:cut]
+
+
+class ShardedClusterDriver(ClusterDriver):
+    """One polling loop serving G consensus groups end to end."""
+
+    def __init__(self, cfg: LogConfig, n_replicas: int, n_groups: int,
+                 *, router: Optional[KeyRouter] = None,
+                 key_of=key_prefix_of, **kw):
+        if kw.get("link_model") is not None:
+            raise ValueError(
+                "sharded driver: attach per-group link models via "
+                "cluster.link_models[g], not link_model=")
+        self.G = int(n_groups)
+        self._router = (router if router is not None
+                        else KeyRouter(self.G))
+        self._key_of = key_of
+        # per-group leader views (the sharded analog of _leader_view;
+        # _leader_view itself becomes the ALL-GROUPS-LED aggregate so
+        # leader()-polling boot code works unchanged)
+        self._group_views: List[int] = [-1] * self.G
+        self._conn_group: Dict[int, int] = {}    # conn -> pinned group
+        self._conn_hold: Dict[int, tuple] = {}   # conn -> held CONNECT
+        super().__init__(cfg, n_replicas, **kw)
+        # (replica, group) commit-waiter FIFOs + replay cursors — the
+        # single-group driver's rt.inflight / rt.replay_cursor, demuxed
+        self._inflight_g: List[List[collections.deque]] = [
+            [collections.deque() for _ in range(self.G)]
+            for _ in range(n_replicas)]
+        self._replay_cursor = [[0] * self.G for _ in range(n_replicas)]
+        # per-group election timers + candidate rotation (group g's
+        # first candidate is replica g % R — round-robin placement)
+        self._gtimers = [ElectionTimer(self.timeout_cfg,
+                                       seed=7000 + 31 * g)
+                         for g in range(self.G)]
+        self._elect_round = [0] * self.G
+
+    def _make_cluster(self, cfg, n_replicas, group_size, mode, fanout,
+                      audit):
+        return ShardedCluster(cfg, n_replicas, self.G,
+                              router=self._router, fanout=fanout,
+                              group_size=group_size, audit=audit)
+
+    @property
+    def router(self) -> KeyRouter:
+        return self._router
+
+    def leaders(self) -> List[int]:
+        with self._lock:
+            return list(self._group_views)
+
+    # ------------------------------------------------------------------
+    # intake: key-prefix routing (see module docstring)
+    # ------------------------------------------------------------------
+
+    def _accepts_clients(self, r: int) -> bool:
+        # every replica fronts the cluster while any group is led; the
+        # per-group availability check happens at SEND routing time
+        return any(v >= 0 for v in self._group_views)
+
+    def _enqueue_locked(self, r: int, rt, etype: int, conn_id: int,
+                        payload: bytes):
+        if etype == int(EntryType.CONNECT):
+            # held until the first SEND names a key; acked immediately
+            # (carries no data — an acked SEND later transitively
+            # proves the CONNECT committed, FIFO within its group)
+            self._conn_hold[conn_id] = payload
+            self.obs.metrics.inc("proxy_events_total", replica=r)
+            return 0
+        g = self._conn_group.get(conn_id)
+        if g is None and etype == int(EntryType.CLOSE):
+            # nothing of this conn ever replicated
+            self._conn_hold.pop(conn_id, None)
+            return 0
+        if g is None:
+            g = self._router.group_of(self._key_of(payload))
+            self._conn_group[conn_id] = g
+        if self._group_views[g] < 0:
+            # the routed group is (transiently) leaderless: fail fast
+            # so the client retries — a commit wait could stall for a
+            # whole election otherwise
+            rt.replicated_conns.discard(conn_id)
+            self._conn_group.pop(conn_id, None)
+            self._conn_hold.pop(conn_id, None)
+            self.obs.metrics.inc("events_refused_total", replica=r)
+            return -1
+        rows = []
+        held = self._conn_hold.pop(conn_id, None)
+        if held is not None:
+            rt.submit_seq += 1
+            rows.append((g, int(EntryType.CONNECT), conn_id, held,
+                         rt.submit_seq))
+        frags = (fragment(payload, self.cfg.slot_bytes)
+                 if etype == int(EntryType.SEND) else [payload])
+        ev = PendingEvent(EntryType(etype), conn_id, payload)
+        for f in frags:
+            rt.submit_seq += 1
+            rows.append((g, etype, conn_id, f, rt.submit_seq))
+        if etype == int(EntryType.CLOSE):
+            self._conn_group.pop(conn_id, None)
+        self._submitq[r].extend(rows)
+        self._inflight_g[r][g].append((ev, rt.submit_seq))
+        self.obs.metrics.inc("proxy_events_total", replica=r)
+        self.obs.trace.record(obs_trace.PROXY_ENQUEUE, replica=r,
+                              etype=etype, conn=conn_id, group=g,
+                              frags=len(frags),
+                              submit_seq=rt.submit_seq)
+        self._wake.set()
+        return ev
+
+    def _pump_submitq(self) -> None:
+        with self._lock, self.cluster._host_lock:
+            views = self._group_views
+            for r in range(self.R):
+                for g, etype, conn, frag, seq in self._submitq[r]:
+                    # the group's CURRENT leader takes the append; if
+                    # leadership vanished since enqueue the row lands
+                    # on a non-leader and is dropped by design — the
+                    # leadership-change sweep fails its waiter
+                    q = views[g] if views[g] >= 0 else 0
+                    self.cluster.submit(g, q, frag, EntryType(etype),
+                                        conn=conn, req_id=seq)
+                self._submitq[r].clear()
+
+    # ------------------------------------------------------------------
+    # stepping
+    # ------------------------------------------------------------------
+
+    def _backlog(self) -> int:
+        return max(len(q) for row in self.cluster.pending for q in row)
+
+    def _waiter_count(self) -> int:
+        return sum(len(dq) for row in self._inflight_g for dq in row)
+
+    def _busy(self) -> bool:
+        with self._lock:
+            return bool(any(self._submitq) or self._backlog()
+                        or self._waiter_count())
+
+    def step(self) -> Dict:
+        """One host-loop iteration: elections for leaderless groups
+        ride the same dispatch as every other group's step; any
+        backlog rides a fused all-groups burst."""
+        self._drain_admin()
+        self._pump_submitq()
+        c = self.cluster
+        timeouts: Dict[int, list] = {}
+        if c.last is not None:
+            for g in range(self.G):
+                if self._group_views[g] >= 0:
+                    continue
+                if self._gtimers[g].expired():
+                    cand = (g + self._elect_round[g]) % self.R
+                    self._elect_round[g] += 1
+                    timeouts[g] = [cand]
+                    self._gtimers[g].beat()
+                    self.obs.metrics.inc("election_timeouts_total",
+                                         group=g)
+        if (not timeouts and c.last is not None
+                and all(v >= 0 for v in self._group_views)
+                and self._backlog()):
+            self._timer_obs.start("device_step")
+            res = c.step_burst()
+            self._timer_obs.stop("device_step")
+        else:
+            self._timer_obs.start("device_step")
+            res = c.step(timeouts=timeouts)
+            self._timer_obs.stop("device_step")
+        return self._post_step(res)
+
+    def _pipeline_ready(self) -> bool:
+        c = self.cluster
+        if c.last is None:
+            return False
+        if any(v < 0 for v in self._group_views):
+            return False
+        if c.need_recovery:
+            return False
+        if int(c.last["end"].max()) >= self.cfg.rebase_threshold:
+            return False
+        # append batches only — see ClusterDriver._pipeline_ready
+        with self._lock:
+            return bool(any(self._submitq) or self._backlog())
+
+    def _update_leader_view(self, res) -> None:
+        views = []
+        for g in range(self.G):
+            claims = [(int(res["term"][g, r]), r)
+                      for r in range(self.R)
+                      if int(res["role"][g, r]) == int(Role.LEADER)]
+            views.append(max(claims)[1] if claims else -1)
+        with self._lock:
+            prev = self._group_views
+            self._group_views = views
+            self._leader_view = (0 if all(v >= 0 for v in views)
+                                 else -1)
+        for g in range(self.G):
+            if views[g] != prev[g] or views[g] < 0:
+                # leadership moved or vanished: entries submitted to
+                # the old leader may never commit — fail g's blocked
+                # waiters so clients retry (late commits are harmless:
+                # acks match by stamped seq, and released events are
+                # terminal)
+                self._fail_group_inflight(g, "leadership change")
+
+    def _fail_group_inflight(self, g: int, site: str) -> None:
+        with self._lock:
+            for r in range(self.R):
+                dq = self._inflight_g[r][g]
+                n = len(dq)
+                if not n:
+                    continue
+                rt = self.runtimes[r]
+                if (rt.proxy is not None and rt.proxy.spec_mode
+                        and not rt.app_dirty):
+                    rt.app_dirty = True
+                    rt.log.info_wtime(
+                        "APP DIRTY: %d speculated events failed at %s "
+                        "(group %d)" % (n, site, g))
+                while dq:
+                    ev, _ = dq.popleft()
+                    ev.release(-1)
+                self.obs.metrics.inc("inflight_failed_total", n,
+                                     replica=r)
+                self.obs.trace.record(obs_trace.INFLIGHT_FAILED,
+                                      replica=r, group=g, count=n,
+                                      site=site)
+
+    def _fail_inflight_locked(self, rt, site: str) -> None:
+        """Fail EVERY group's blocked waiters on this replica (caller
+        holds ``_lock``) — crash/stop paths."""
+        n = sum(len(dq) for dq in self._inflight_g[rt.idx])
+        if (n and rt.proxy is not None and rt.proxy.spec_mode
+                and not rt.app_dirty):
+            rt.app_dirty = True
+            rt.log.info_wtime(
+                "APP DIRTY: %d speculated events failed at %s"
+                % (n, site))
+        for dq in self._inflight_g[rt.idx]:
+            while dq:
+                ev, _ = dq.popleft()
+                ev.release(-1)
+        if n:
+            self.obs.metrics.inc("inflight_failed_total", n,
+                                 replica=rt.idx)
+            self.obs.trace.record(obs_trace.INFLIGHT_FAILED,
+                                  replica=rt.idx, count=n, site=site)
+
+    def _post_step(self, res) -> Dict:
+        self._update_leader_view(res)
+        for g in range(self.G):
+            if self._group_views[g] >= 0:
+                self._gtimers[g].beat()
+        for r, rt in enumerate(self.runtimes):
+            self._apply_new_entries(r, rt)
+        self._observe_step(res)
+        return res
+
+    # ------------------------------------------------------------------
+    # apply / ack release (per group)
+    # ------------------------------------------------------------------
+
+    def _apply_new_entries(self, r: int, rt) -> None:
+        c = self.cluster
+        progressed = False
+        releases: list = []
+        replaying = rt.replay is not None and not rt.app_dirty
+        for g in range(self.G):
+            stream = c.replayed[g][r]
+            n = len(stream)
+            cur = self._replay_cursor[r][g]
+            if cur >= n:
+                continue
+            new = stream[cur:]
+            self._replay_cursor[r][g] = n
+            progressed = True
+            if rt.store is not None:
+                blobs = c.frames[g][r]
+                if blobs:
+                    c.frames[g][r] = []
+                    for b in blobs:
+                        rt.store.append_framed(b)
+            own_max = -1
+            run_conn, run_buf = -1, []
+
+            def flush_run():
+                nonlocal run_conn, run_buf
+                if run_conn >= 0 and run_buf:
+                    rt.replay.apply(int(EntryType.SEND), run_conn,
+                                    b"".join(run_buf))
+                run_conn, run_buf = -1, []
+
+            for etype, conn, req, payload in new:
+                if conn_origin(conn) != r:
+                    if not replaying:
+                        continue
+                    if etype == int(EntryType.SEND):
+                        if conn != run_conn:
+                            flush_run()
+                            run_conn = conn
+                        run_buf.append(payload)
+                    else:
+                        flush_run()
+                        rt.replay.apply(etype, conn, payload)
+                else:
+                    own_max = req
+            if replaying:
+                flush_run()
+            if own_max >= 0:
+                self._phase_prof.start("ack_release")
+                with self._lock:
+                    dq = self._inflight_g[r][g]
+                    while dq and dq[0][1] <= own_max:
+                        ev, _ = dq.popleft()
+                        releases.append(ev)
+                self._phase_prof.stop("ack_release")
+        if progressed and replaying:
+            rt.replay.drain_responses()
+        if progressed and rt.store is not None:
+            now = time.monotonic()
+            if now - rt.last_sync > self.sync_period:
+                rt.store.sync()
+                rt.last_sync = now
+        if releases:
+            now = time.perf_counter()
+            for ev in releases:
+                ev.release(0)
+                self.obs.metrics.observe(
+                    "commit_latency_seconds", now - ev.t0,
+                    buckets=LATENCY_BUCKETS_S, replica=r)
+            self.obs.trace.record(obs_trace.PROXY_ACK_RELEASE,
+                                  replica=r, count=len(releases))
+
+    # ------------------------------------------------------------------
+    # observability / health
+    # ------------------------------------------------------------------
+
+    def _observe_step(self, res) -> None:
+        m = self.obs.metrics
+        for r in range(self.R):
+            m.set("inflight_waiters",
+                  sum(len(dq) for dq in self._inflight_g[r]),
+                  replica=r)
+        m.set("cluster_leader", self._leader_view)
+        now = time.monotonic()
+        if now - self._alert_last >= self._alert_period:
+            self._alert_last = now
+            self.evaluate_alerts()
+        if self._health is not None and self._health.due():
+            try:
+                self._health.write(self._health_snapshots(res))
+            except OSError:
+                pass    # evidence I/O never kills the data path
+
+    def _health_snapshots(self, res) -> Dict[int, Dict]:
+        snaps = {}
+        for r in range(self.R):
+            rt = self.runtimes[r]
+            snaps[r] = make_snapshot(
+                replica=r,
+                groups_led=[g for g in range(self.G)
+                            if self._group_views[g] == r],
+                inflight=sum(len(dq) for dq in self._inflight_g[r]),
+                app_dirty=rt.app_dirty,
+                store=(rt.store.stats() if rt.store is not None
+                       else None))
+        return snaps
+
+    def health(self) -> Dict:
+        h = self.cluster.health()
+        h.update(
+            leaders=self.leaders(),
+            all_groups_led=self.leader() >= 0,
+            replicas=[snap for _, snap in
+                      sorted(self._health_snapshots(None).items())],
+            loop_error=(repr(self.loop_error) if self.loop_error
+                        else None),
+            alerts=self.alerts.state(),
+            audit_artifact=self.audit_artifact,
+            ts=time.time())
+        return h
+
+    def can_serve_read(self, r: int) -> bool:
+        """True iff replica ``r`` verified its leadership on the latest
+        step for EVERY group it leads (and leads at least one)."""
+        last = self.cluster.last
+        if last is None:
+            return False
+        led = [g for g in range(self.G) if self._group_views[g] == r]
+        return bool(led) and all(
+            bool(last["leadership_verified"][g, r]) for g in led)
+
+    # ------------------------------------------------------------------
+    # unsupported single-group operator surfaces
+    # ------------------------------------------------------------------
+
+    def request_membership(self, new_mask: int) -> None:
+        raise NotImplementedError(
+            "membership changes are single-group only (ROADMAP: "
+            "elastic resharding)")
+
+    def recover_replica(self, r, donor=None, timeout: float = 60.0):
+        raise NotImplementedError(
+            "snapshot recovery is single-group only")
+
+    def reset_app(self, r: int, timeout: float = 60.0) -> None:
+        raise NotImplementedError("app reset is single-group only")
+
+    def checkpoint_app(self, r: int, timeout: float = 60.0) -> None:
+        raise NotImplementedError(
+            "app checkpoints are single-group only")

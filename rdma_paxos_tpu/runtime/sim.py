@@ -12,6 +12,8 @@ the analog of ``reconf_bench.sh`` killing processes, but reproducible.
 
 from __future__ import annotations
 
+import collections
+import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -26,7 +28,6 @@ from rdma_paxos_tpu.consensus.step import StepInput, fetch_window
 from rdma_paxos_tpu.parallel.mesh import (
     build_sim_burst, build_sim_step, build_spmd_burst, build_spmd_step,
     make_replica_mesh, stack_states)
-from rdma_paxos_tpu.utils.codec import bytes_to_words
 
 
 # Compiled steps are shared across ALL cluster engines (same static
@@ -37,6 +38,169 @@ from rdma_paxos_tpu.utils.codec import bytes_to_words
 # the same LogConfig never compile the same program twice, and tests
 # can assert cache-key sets across both engines.
 STEP_CACHE: Dict[tuple, object] = {}
+
+
+# ---------------------------------------------------------------------------
+# Shared host-bookkeeping rules — ONE implementation for BOTH engines
+# (SimCluster and shard.cluster.ShardedCluster). These four rules used
+# to be duplicated with a group index bolted on; any drift between the
+# copies silently broke the G=1 ≡ SimCluster bit-equivalence contract,
+# so the rules now live here and both engines call them (the ROADMAP
+# carried-over refactor unlocking the mesh/e2e/resharding work).
+# ---------------------------------------------------------------------------
+
+def require_drained(tickets, site: str) -> None:
+    """Serial-path rule: a fused ``step()``/``step_burst()`` while
+    dispatches are in flight would finish out of FIFO order AND mutate
+    the pending queues before the violation surfaced — refuse up
+    front, before any batch take."""
+    if tickets:
+        raise RuntimeError(
+            "%s() with %d in-flight dispatch(es): finish the "
+            "pipeline first" % (site, len(tickets)))
+
+
+def requeue_shortfall(pending: List, take: List, acc: int) -> None:
+    """Step/requeue rule: appends stop entirely the step the replica
+    is not leader and the device capacity clamp drops suffixes only,
+    so the appended set is always a PREFIX of ``take`` — requeue the
+    remainder at the FRONT of ``pending``, in order (in place)."""
+    if acc < len(take):
+        pending[:0] = take[acc:]
+
+
+def clamp_burst_take(pending_len: int, end: int, head: int,
+                     n_slots: int, max_take: int,
+                     reserved: int = 0) -> int:
+    """Burst capacity rule: never enqueue more than the ring can take
+    without drops (mid-burst drops would reorder a connection's
+    fragments against later steps). ``reserved`` subtracts appends
+    already dispatched but not yet reflected in ``end`` (in-flight
+    pipelined tickets)."""
+    avail = (n_slots - 1) - (end - head) - reserved
+    return min(pending_len, max(avail, 0), max_take)
+
+
+def rebase_delta_of(heads: Sequence[int], n_slots: int) -> int:
+    """Rebase frontier rule: the coordinated i32-rollover delta is the
+    minimum head rounded DOWN to a multiple of ``n_slots`` (the slot
+    of global index g is g % n_slots and entries do not move, so the
+    subtraction must preserve the mapping). <= 0 means 'cannot fire'
+    (a lagging head pins the rollover — the stall-surfacing path)."""
+    if not heads:
+        return 0
+    return min(heads) & ~(n_slots - 1)
+
+
+def decode_window(wm: np.ndarray, wd: np.ndarray, n: int,
+                  replayed: List, frames: Optional[List],
+                  collect_frames: bool) -> None:
+    """Replay frontier rule: vectorized decode of ``n`` fetched
+    entries — one contiguous byte view + one column read per field
+    (per-entry scalar conversions dominated the replay path at high
+    rates) — appending client entries to ``replayed`` and, when a
+    consumer opted in, the store-ready framed blob to ``frames``."""
+    types = wm[:n, M_TYPE]
+    client = ((types >= int(EntryType.CONNECT))
+              & (types <= int(EntryType.CLOSE)))
+    idxs = np.nonzero(client)[0]
+    if not idxs.size:
+        return
+    conns = wm[:n, M_CONN]
+    reqs = wm[:n, M_REQID]
+    lens = wm[:n, M_LEN]
+    raw = np.ascontiguousarray(wd[:n]).view(np.uint8).reshape(n, -1)
+    row = raw.shape[1]
+    buf = raw.tobytes()
+    for j in idxs:
+        o = int(j) * row
+        replayed.append((int(types[j]), int(conns[j]), int(reqs[j]),
+                         buf[o:o + int(lens[j])]))
+    if collect_frames:
+        frames.append(assemble_frames(types, conns, lens, raw, idxs))
+
+
+class StepTicket:
+    """One dispatched-but-not-finished protocol step/burst.
+
+    ``begin_step``/``begin_burst`` encode + dispatch and return one of
+    these immediately (the device program runs asynchronously);
+    ``finish`` blocks on the outputs and runs every post-step host
+    rule. Serial ``step()``/``step_burst()`` are exactly
+    ``finish(begin_*())`` — the pipelined driver simply keeps more
+    than one ticket in flight."""
+
+    __slots__ = ("kind", "out", "taken", "timeouts", "K", "bufs")
+
+    def __init__(self, kind: str, out, taken, timeouts, K: int, bufs):
+        self.kind = kind          # "step" | "burst"
+        self.out = out            # device output pytree (futures)
+        self.taken = taken        # per-replica (or [g][r]) popped rows
+        self.timeouts = timeouts
+        self.K = K
+        self.bufs = bufs          # staging buffer set (pool-owned)
+
+
+class StagingPool:
+    """Persistent, reusable host staging buffers for window encode.
+
+    Allocating + zeroing the [R, B, slot_words] batch arrays every
+    step was a measurable share of ``host_encode``; the pool hands out
+    preallocated sets and zeroes ONLY the rows the previous user
+    actually wrote (recorded at release). A set stays checked out for
+    the lifetime of its ticket, so a pipelined driver can never
+    overwrite a buffer an in-flight dispatch is still reading —
+    double-buffering falls out of the pool discipline (depth D keeps
+    at most D+1 sets alive)."""
+
+    def __init__(self):
+        self._pools: Dict[tuple, List[dict]] = {}
+        self._lock = threading.Lock()
+
+    def acquire(self, key: tuple, make) -> dict:
+        with self._lock:
+            pool = self._pools.setdefault(key, [])
+            if pool:
+                return pool.pop()
+        bufs = make()
+        # u8 view of the payload words: zero-copy packing target (one
+        # bytes->row copy per entry instead of pad+frombuffer+copy)
+        bufs["data_u8"] = bufs["data"].view(np.uint8)
+        bufs["key"] = key
+        return bufs
+
+    def release(self, bufs: dict, dirty_rows) -> None:
+        """Return a set; ``dirty_rows`` yields (index-tuple, n) pairs —
+        the rows written since acquire — which are zeroed here so the
+        next acquire starts clean without a full-buffer memset."""
+        data, meta = bufs["data"], bufs["meta"]
+        for idx, n in dirty_rows:
+            if n > 0:
+                data[idx][:n] = 0
+                meta[idx][:n] = 0
+        with self._lock:
+            self._pools[bufs["key"]].append(bufs)
+
+
+def pack_rows(bufs: dict, idx: tuple, take: Sequence[Tuple],
+              slot_bytes: int) -> None:
+    """Zero-copy entry packing: write (etype, conn, req, payload) rows
+    straight into the staging buffers at ``idx`` (e.g. ``(r,)`` or
+    ``(k, g, r)``) — the single packing rule for both engines."""
+    du8 = bufs["data_u8"][idx]
+    mt = bufs["meta"][idx]
+    for i, (t, conn, req, payload) in enumerate(take):
+        ln = len(payload)
+        if ln > slot_bytes:
+            raise ValueError("payload exceeds slot capacity; "
+                             "fragment first")
+        if ln:
+            du8[i, :ln] = np.frombuffer(payload, np.uint8)
+        row = mt[i]
+        row[M_TYPE] = t
+        row[M_CONN] = conn
+        row[M_REQID] = req
+        row[M_LEN] = ln
 
 
 def assemble_frames(types, conns, lens, raw, idxs) -> bytes:
@@ -128,8 +292,18 @@ class SimCluster:
         self.peer_mask = np.ones((n_replicas, n_replicas), np.int32)
         self.pending: List[List[Tuple[int, int, int, bytes]]] = [
             [] for _ in range(n_replicas)]
-        self._inflight: List[List[Tuple[int, int, int, bytes]]] = [
-            [] for _ in range(n_replicas)]
+        # pipelined dispatch (begin_*/finish): FIFO of in-flight
+        # tickets, the staging-buffer pool, and the dispatch
+        # concurrency counters (max_inflight_dispatches is the
+        # acceptance witness that the pipeline really overlapped).
+        # _host_lock guards the host queues (pending/applied/last)
+        # against the dispatch-thread/readback-thread split — serial
+        # callers pay one uncontended acquire.
+        self._tickets: collections.deque = collections.deque()
+        self._staging = StagingPool()
+        self._host_lock = threading.RLock()
+        self.inflight_dispatches = 0
+        self.max_inflight_dispatches = 0
         self.last: Optional[Dict[str, np.ndarray]] = None
         # (type, conn_id, req_id, payload) per replica, in apply order
         self.replayed: List[List[Tuple[int, int, int, bytes]]] = [
@@ -177,6 +351,11 @@ class SimCluster:
         # the logical clock the model's per-step randomness keys on.
         self.link_model = None
         self.step_index = 0
+        # dispatch-side logical clock: advances at begin_* (step_index
+        # advances at finish) so an in-flight pipeline never feeds the
+        # link model the same per-step randomness twice; serial callers
+        # see the two clocks equal at every dispatch.
+        self._dispatch_clock = 0
 
     # ---------------- client-side API ----------------
 
@@ -184,8 +363,13 @@ class SimCluster:
                etype: EntryType = EntryType.SEND, conn: int = 1,
                req_id: int = 0) -> None:
         """Queue a client entry for the next step on `replica` (it only
-        enters the log if that replica is leader — proxy semantics)."""
-        self.pending[replica].append((int(etype), conn, req_id, payload))
+        enters the log if that replica is leader — proxy semantics).
+        Locked: a concurrent ``begin_*`` batch take swaps the pending
+        list object, and an unlocked append to the old object would be
+        silently lost."""
+        with self._host_lock:
+            self.pending[replica].append(
+                (int(etype), conn, req_id, payload))
 
     def partition(self, groups: Sequence[Sequence[int]]) -> None:
         """Split the cluster: replicas hear only same-group peers."""
@@ -225,47 +409,270 @@ class SimCluster:
         if self.link_model is None:
             return self.peer_mask
         return self.link_model.effective_mask(self.peer_mask,
-                                              self.step_index)
+                                              self._dispatch_clock)
 
-    def _build_inputs(self, timeouts: Sequence[int]) -> StepInput:
-        cfg, R = self.cfg, self.R
+    # burst size tiers: the smallest tier >= the steps needed is compiled
+    # (bounded recompiles) and padded with zero-count steps
+    K_TIERS = (2, 4, 8, 16)
+
+    # step() result keys pulled to host numpy each dispatch
+    RES_KEYS = ("term", "role", "leader_id", "voted_term", "voted_for",
+                "head", "apply", "commit", "end", "hb_seen",
+                "became_leader", "acked", "accepted", "peer_acked",
+                "leadership_verified", "rebase_delta")
+
+    def _step_bufs(self) -> dict:
+        cfg, R, B = self.cfg, self.R, self.cfg.batch_slots
+        return self._staging.acquire(
+            ("step", R, B), lambda: dict(
+                data=np.zeros((R, B, cfg.slot_words), np.int32),
+                meta=np.zeros((R, B, META_W), np.int32)))
+
+    def _burst_bufs(self, K: int) -> dict:
+        cfg, R, B = self.cfg, self.R, self.cfg.batch_slots
+        return self._staging.acquire(
+            ("burst", K, R, B), lambda: dict(
+                data=np.zeros((K, R, B, cfg.slot_words), np.int32),
+                meta=np.zeros((K, R, B, META_W), np.int32)))
+
+    def reserved_appends(self) -> np.ndarray:
+        """Per-replica appends dispatched but not yet finished — the
+        pipelined capacity reservation (``end`` has not caught up)."""
+        out = np.zeros(self.R, np.int64)
+        for t in self._tickets:
+            for r in range(self.R):
+                out[r] += len(t.taken[r])
+        return out
+
+    def begin_step(self, timeouts: Sequence[int] = (),
+                   take_batch: bool = True) -> StepTicket:
+        """Encode + DISPATCH one protocol step; returns immediately
+        with the in-flight ticket (pass to :meth:`finish`, FIFO). With
+        ``take_batch=False`` no client entries are packed (heartbeat /
+        election dispatches of the pipelined driver, which routes all
+        appends through capacity-clamped bursts so a shortfall requeue
+        can never reorder against in-flight dispatches)."""
+        timeouts = list(timeouts)       # may be a one-shot iterable
+        prof = self.profiler
+        if prof is not None:
+            prof.start("host_encode")
+        cfg, R, B = self.cfg, self.R, self.cfg.batch_slots
         mask = self._effective_mask()
         if self._fanout == "psum" and not mask.all():
             raise ValueError(
                 "psum fan-out requires full connectivity; use "
                 "fanout='gather' to model partitions")
-        B = cfg.batch_slots
-        data = np.zeros((R, B, cfg.slot_words), np.int32)
-        meta = np.zeros((R, B, META_W), np.int32)
+        bufs = self._step_bufs()
         count = np.zeros((R,), np.int32)
-        for r in range(R):
-            take = self.pending[r][:B]
-            self.pending[r] = self.pending[r][B:]
-            self._inflight[r] = take
-            for i, (t, conn, req, payload) in enumerate(take):
-                data[r, i] = bytes_to_words(payload, cfg.slot_words)
-                meta[r, i, M_TYPE] = t
-                meta[r, i, M_CONN] = conn
-                meta[r, i, M_REQID] = req
-                meta[r, i, M_LEN] = len(payload)
-            count[r] = len(take)
+        with self._host_lock:
+            taken = []
+            for r in range(R):
+                take = self.pending[r][:B] if take_batch else []
+                if take:
+                    self.pending[r] = self.pending[r][B:]
+                taken.append(take)
+            qdepth = np.array([len(q) for q in self.pending], np.int32)
+            applied = self.applied.astype(np.int32)
+        for r, take in enumerate(taken):
+            if take:
+                pack_rows(bufs, (r,), take, cfg.slot_bytes)
+                count[r] = len(take)
         tmo = np.zeros((R,), np.int32)
         for r in timeouts:
             tmo[r] = 1
-        return StepInput(
-            batch_data=jnp.asarray(data),
-            batch_meta=jnp.asarray(meta),
+        inp = StepInput(
+            batch_data=jnp.asarray(bufs["data"]),
+            batch_meta=jnp.asarray(bufs["meta"]),
             batch_count=jnp.asarray(count),
             timeout_fired=jnp.asarray(tmo),
             peer_mask=jnp.asarray(mask),
-            apply_done=jnp.asarray(self.applied.astype(np.int32)),
-            queue_depth=jnp.asarray(
-                np.array([len(q) for q in self.pending], np.int32)),
+            apply_done=jnp.asarray(applied),
+            queue_depth=jnp.asarray(qdepth),
         )
+        # no timer fired ⟹ Phase B is provably a no-op: dispatch the
+        # stable step (bit-identical outputs, one fewer collective)
+        fn = (self._build_step(elections=False)
+              if self._stable_fast_path and not timeouts
+              else self._step)
+        if prof is not None:
+            prof.stop("host_encode")
+            prof.start("device_dispatch")
+        with self._host_lock:
+            self.state, out = fn(self.state, inp)
+            ticket = StepTicket("step", out, taken, timeouts, 1, bufs)
+            self._tickets.append(ticket)
+            self.inflight_dispatches += 1
+            self.max_inflight_dispatches = max(
+                self.max_inflight_dispatches, self.inflight_dispatches)
+        if prof is not None:
+            prof.stop("device_dispatch")
+        self._dispatch_clock += 1
+        return ticket
 
-    # burst size tiers: the smallest tier >= the steps needed is compiled
-    # (bounded recompiles) and padded with zero-count steps
-    K_TIERS = (2, 4, 8, 16)
+    def begin_burst(self) -> StepTicket:
+        """Encode + DISPATCH up to ``max(K_TIERS)`` fused protocol
+        steps; returns immediately with the in-flight ticket. Capacity
+        sizing subtracts appends reserved by OTHER in-flight tickets,
+        so pipelined bursts can never overrun the ring (a mid-burst
+        drop would reorder a connection's fragments)."""
+        cfg, R, B = self.cfg, self.R, self.cfg.batch_slots
+        assert self.last is not None, "burst requires a stepped cluster"
+        prof = self.profiler
+        if prof is not None:
+            prof.start("host_encode")
+        mask = self._effective_mask()
+        if self._fanout == "psum" and not mask.all():
+            raise ValueError(
+                "psum fan-out requires full connectivity; use "
+                "fanout='gather' to model partitions")
+        with self._host_lock:
+            # capacity sizing: never enqueue more than the ring can
+            # take without drops, so mid-burst drops (which would
+            # reorder a connection's fragments against later steps)
+            # cannot occur
+            reserved = self.reserved_appends()
+            last = self.last
+            taken: List[List[Tuple[int, int, int, bytes]]] = []
+            take_n = []
+            for r in range(R):
+                n = clamp_burst_take(
+                    len(self.pending[r]), int(last["end"][r]),
+                    int(last["head"][r]), cfg.n_slots,
+                    self.K_TIERS[-1] * B, int(reserved[r]))
+                take_n.append(n)
+                taken.append(self.pending[r][:n])
+                self.pending[r] = self.pending[r][n:]
+            qdepth = np.array([len(q) for q in self.pending], np.int32)
+            applied = self.applied.astype(np.int32)
+        k_needed = max(1, max(-(-n // B) for n in take_n))
+        K = next(k for k in self.K_TIERS if k >= k_needed)
+        bufs = self._burst_bufs(K)
+        count = np.zeros((K, R), np.int32)
+        for r in range(R):
+            n = take_n[r]
+            for k in range(-(-n // B) if n else 0):
+                pack_rows(bufs, (k, r), taken[r][k * B:(k + 1) * B],
+                          cfg.slot_bytes)
+            for k in range(K):
+                count[k, r] = max(0, min(n - k * B, B))
+        fn = self._burst_fn(K)
+        if prof is not None:
+            prof.stop("host_encode")
+            prof.start("device_dispatch")
+        with self._host_lock:
+            self.state, outs = fn(
+                self.state, jnp.asarray(bufs["data"]),
+                jnp.asarray(bufs["meta"]), jnp.asarray(count),
+                jnp.asarray(mask), jnp.asarray(applied),
+                jnp.asarray(qdepth))
+            ticket = StepTicket("burst", outs, taken, (), K, bufs)
+            self._tickets.append(ticket)
+            self.inflight_dispatches += 1
+            self.max_inflight_dispatches = max(
+                self.max_inflight_dispatches, self.inflight_dispatches)
+        if prof is not None:
+            prof.stop("device_dispatch")
+        self._dispatch_clock += K
+        return ticket
+
+    def finish(self, ticket: StepTicket) -> Dict[str, np.ndarray]:
+        """Block on ``ticket``'s outputs and run every post-step host
+        rule (requeue, replay, audit, flight, rebase, spans) — tickets
+        MUST finish in dispatch order. ``step()``/``step_burst()`` are
+        exactly ``finish(begin_*())``; the pipelined driver finishes
+        from its readback thread while the next dispatch encodes."""
+        assert self._tickets and self._tickets[0] is ticket, \
+            "tickets must finish in dispatch (FIFO) order"
+        # NOT popped here: until ``last`` below reflects this ticket's
+        # appends, a concurrent ``begin_*`` must keep counting them via
+        # reserved_appends() — an early pop would let its capacity
+        # clamp over-admit (and a lockless pop would mutate the deque
+        # under the dispatch thread's locked iteration)
+        prof = self.profiler
+        out = ticket.out
+        burst = ticket.kind == "burst"
+        if prof is not None:
+            prof.sync(out)              # fenced device_sync (opt-in)
+            prof.start("quorum_wait")
+        if burst:
+            res = {k: np.asarray(getattr(out, k))[-1]
+                   for k in self.RES_KEYS if k != "accepted"}
+            acc = np.asarray(out.accepted).sum(axis=0)       # [R]
+            res["accepted"] = acc
+        else:
+            res = {k: np.asarray(getattr(out, k))
+                   for k in self.RES_KEYS}
+        if prof is not None:
+            prof.stop("quorum_wait")
+        if self._audit:
+            # ingest BEFORE _maybe_rebase: the emitted indices are raw
+            # (pre-rollover), consistent with the current rebased_total
+            if burst:
+                # each fused step emitted its own digest window: ingest
+                # them in order so the tiling property (no gaps) holds
+                a_s = np.asarray(out.audit_start)      # [K, R]
+                a_d = np.asarray(out.audit_digest)     # [K, R, W]
+                a_t = np.asarray(out.audit_term)       # [K, R, W]
+                a_c = np.asarray(out.commit)           # [K, R]
+                for k in range(a_s.shape[0]):
+                    self._ingest_audit(a_s[k], a_d[k], a_t[k], a_c[k])
+                res["audit_start"] = a_s[-1]
+                res["audit_digest"] = a_d[-1]
+                res["audit_term"] = a_t[-1]
+            else:
+                for k in ("audit_start", "audit_digest", "audit_term"):
+                    res[k] = np.asarray(getattr(out, k))
+                self._ingest_audit(res["audit_start"],
+                                   res["audit_digest"],
+                                   res["audit_term"], res["commit"])
+        # ring-full backpressure / deposition: the appended set is a
+        # PREFIX of ``taken`` — requeue the remainder in order
+        # (submissions to non-leaders are dropped by design)
+        with self._host_lock:
+            for r in range(self.R):
+                take = ticket.taken[r]
+                if take and res["role"][r] == int(Role.LEADER):
+                    acc_r = int(res["accepted"][r])
+                    self._stamp_appends(r, take, acc_r, res)
+                    requeue_shortfall(self.pending[r], take, acc_r)
+        if prof is not None:
+            prof.start("apply")
+        self._replay_committed(res)
+        if prof is not None:
+            prof.stop("apply")
+        if self._audit:
+            self._record_flight(res, ticket.taken, ticket.timeouts,
+                                burst_k=ticket.K)
+        # the i32 rollover rewrites offsets host-side: it must never
+        # run under dispatches still in flight (their outputs carry
+        # pre-rollover offsets) — defer until the pipeline drains; the
+        # threshold stays crossed, so the draining finish applies it
+        with self._host_lock:
+            self._tickets.popleft()     # retire: last now covers it
+            self.inflight_dispatches -= 1
+            if not self._tickets:
+                self._maybe_rebase(res)
+            self.last = res
+        self.step_index += ticket.K
+        self._observe_spans(res)
+        if burst:
+            B = self.cfg.batch_slots
+            self._staging.release(ticket.bufs, [
+                ((k, r), min(B, len(t) - k * B))
+                for r, t in enumerate(ticket.taken)
+                for k in range(-(-len(t) // B) if t else 0)])
+        else:
+            self._staging.release(ticket.bufs, [
+                ((r,), len(t)) for r, t in enumerate(ticket.taken)])
+        return res
+
+    def drain(self) -> Optional[Dict[str, np.ndarray]]:
+        """Finish every in-flight ticket in order; returns the final
+        result (or None when nothing was in flight)."""
+        res = None
+        while self._tickets:
+            res = self.finish(self._tickets[0])
+        return res
 
     def _burst_fn(self, K: int):
         # the "audit" marker is appended ONLY when auditing: default
@@ -298,112 +705,8 @@ class SimCluster:
         election timeouts fire inside the burst; the caller must only
         burst while a leader is known. Returns the final step's outputs
         (``accepted`` aggregated over the burst)."""
-        cfg, R, B = self.cfg, self.R, self.cfg.batch_slots
-        assert self.last is not None, "burst requires a stepped cluster"
-        prof = self.profiler
-        if prof is not None:
-            prof.start("host_encode")
-        # capacity sizing: never enqueue more than the ring can take
-        # without drops, so mid-burst drops (which would reorder a
-        # connection's fragments against later steps) cannot occur
-        take_n = []
-        for r in range(R):
-            avail = (cfg.n_slots - 1) - (int(self.last["end"][r])
-                                         - int(self.last["head"][r]))
-            take_n.append(min(len(self.pending[r]), max(avail, 0),
-                              self.K_TIERS[-1] * B))
-        k_needed = max(1, max(-(-n // B) for n in take_n))
-        K = next(k for k in self.K_TIERS if k >= k_needed)
-
-        data = np.zeros((K, R, B, cfg.slot_words), np.int32)
-        meta = np.zeros((K, R, B, META_W), np.int32)
-        count = np.zeros((K, R), np.int32)
-        taken: List[List[Tuple[int, int, int, bytes]]] = []
-        for r in range(R):
-            take = self.pending[r][:take_n[r]]
-            self.pending[r] = self.pending[r][take_n[r]:]
-            taken.append(take)
-            for i, (t, conn, req, payload) in enumerate(take):
-                k, j = divmod(i, B)
-                data[k, r, j] = bytes_to_words(payload, cfg.slot_words)
-                meta[k, r, j, M_TYPE] = t
-                meta[k, r, j, M_CONN] = conn
-                meta[k, r, j, M_REQID] = req
-                meta[k, r, j, M_LEN] = len(payload)
-            for k in range(K):
-                count[k, r] = max(0, min(take_n[r] - k * B, B))
-
-        # one effective mask covers the whole fused burst (the link
-        # model's granularity is a dispatch, not an inner step); the
-        # logical clock still advances by K so per-step randomness
-        # never replays across dispatches
-        mask = self._effective_mask()
-        if self._fanout == "psum" and not mask.all():
-            raise ValueError(
-                "psum fan-out requires full connectivity; use "
-                "fanout='gather' to model partitions")
-        fn = self._burst_fn(K)
-        if prof is not None:
-            prof.stop("host_encode")
-            prof.start("device_dispatch")
-        self.state, outs = fn(self.state, jnp.asarray(data),
-                              jnp.asarray(meta), jnp.asarray(count),
-                              jnp.asarray(mask),
-                              jnp.asarray(self.applied.astype(np.int32)),
-                              jnp.asarray(np.array(
-                                  [len(q) for q in self.pending],
-                                  np.int32)))
-        if prof is not None:
-            prof.stop("device_dispatch")
-            prof.sync(outs)             # fenced device_sync (opt-in)
-            prof.start("quorum_wait")
-        res = {k: np.asarray(getattr(outs, k))[-1]
-               for k in ("term", "role", "leader_id", "voted_term",
-                         "voted_for", "head", "apply", "commit", "end",
-                         "hb_seen", "became_leader", "acked",
-                         "peer_acked", "leadership_verified",
-                         "rebase_delta")}
-        acc = np.asarray(outs.accepted).sum(axis=0)         # [R]
-        res["accepted"] = acc
-        if prof is not None:
-            prof.stop("quorum_wait")
-        if self._audit:
-            # each fused step emitted its own digest window: ingest
-            # them in order so the tiling property (no gaps) holds
-            a_s = np.asarray(outs.audit_start)      # [K, R]
-            a_d = np.asarray(outs.audit_digest)     # [K, R, W]
-            a_t = np.asarray(outs.audit_term)       # [K, R, W]
-            a_c = np.asarray(outs.commit)           # [K, R]
-            for k in range(a_s.shape[0]):
-                self._ingest_audit(a_s[k], a_d[k], a_t[k], a_c[k])
-            res["audit_start"], res["audit_digest"] = a_s[-1], a_d[-1]
-            res["audit_term"] = a_t[-1]
-        # Shortfall: appends stop entirely the step the replica is not
-        # leader and the capacity clamp drops suffixes only, so the
-        # appended set is always a PREFIX of ``taken`` — requeue the
-        # remainder in order, exactly like step() does (never raise:
-        # this runs on the poll thread). A replica deposed mid-burst
-        # drops its remainder by design, mirroring step()'s non-leader
-        # rule — the driver fails the blocked events so clients retry
-        # against the new leader.
-        for r in range(R):
-            if taken[r] and res["role"][r] == int(Role.LEADER):
-                a = int(acc[r])
-                self._stamp_appends(r, taken[r], a, res)
-                if a < len(taken[r]):
-                    self.pending[r] = taken[r][a:] + self.pending[r]
-        if prof is not None:
-            prof.start("apply")
-        self._replay_committed(res)
-        if prof is not None:
-            prof.stop("apply")
-        if self._audit:
-            self._record_flight(res, taken, (), burst_k=K)
-        self._maybe_rebase(res)
-        self.last = res
-        self.step_index += K
-        self._observe_spans(res)
-        return res
+        require_drained(self._tickets, "step_burst")
+        return self.finish(self.begin_burst())
 
     def _build_step(self, *, elections: bool):
         """Compile (or fetch cached) the protocol step for this cluster's
@@ -454,65 +757,8 @@ class SimCluster:
                jnp.zeros((R,), jnp.int32))
 
     def step(self, timeouts: Sequence[int] = ()) -> Dict[str, np.ndarray]:
-        timeouts = list(timeouts)       # may be a one-shot iterable
-        prof = self.profiler
-        if prof is not None:
-            prof.start("host_encode")
-        inp = self._build_inputs(timeouts)
-        # no timer fired ⟹ Phase B is provably a no-op: dispatch the
-        # stable step (bit-identical outputs, one fewer collective)
-        fn = (self._build_step(elections=False)
-              if self._stable_fast_path and not timeouts
-              else self._step)
-        if prof is not None:
-            prof.stop("host_encode")
-            prof.start("device_dispatch")
-        self.state, out = fn(self.state, inp)
-        if prof is not None:
-            prof.stop("device_dispatch")
-            prof.sync(out)              # fenced device_sync (opt-in)
-            prof.start("quorum_wait")
-        res = {k: np.asarray(getattr(out, k))
-               for k in ("term", "role", "leader_id", "voted_term",
-                         "voted_for", "head", "apply",
-                         "commit", "end", "hb_seen", "became_leader",
-                         "acked", "accepted", "peer_acked",
-                         "leadership_verified", "rebase_delta")}
-        if prof is not None:
-            prof.stop("quorum_wait")
-        if self._audit:
-            # after the quorum_wait stop: audit host work must not
-            # inflate the PR3 phase attribution it sits next to
-            for k in ("audit_start", "audit_digest", "audit_term"):
-                res[k] = np.asarray(getattr(out, k))
-            # ingest BEFORE _maybe_rebase: the emitted indices are raw
-            # (pre-rollover), consistent with the current rebased_total
-            self._ingest_audit(res["audit_start"], res["audit_digest"],
-                               res["audit_term"], res["commit"])
-            flight_taken = [list(t) for t in self._inflight]
-        # ring-full backpressure: entries the leader could not append are
-        # requeued in order (submissions to non-leaders are dropped by
-        # design — proxy submits on the leader only)
-        for r in range(self.R):
-            take = self._inflight[r]
-            self._inflight[r] = []
-            if take and res["role"][r] == int(Role.LEADER):
-                acc = int(res["accepted"][r])
-                self._stamp_appends(r, take, acc, res)
-                if acc < len(take):
-                    self.pending[r] = take[acc:] + self.pending[r]
-        if prof is not None:
-            prof.start("apply")
-        self._replay_committed(res)
-        if prof is not None:
-            prof.stop("apply")
-        if self._audit:
-            self._record_flight(res, flight_taken, timeouts)
-        self._maybe_rebase(res)
-        self.last = res
-        self.step_index += 1
-        self._observe_spans(res)
-        return res
+        require_drained(self._tickets, "step")
+        return self.finish(self.begin_step(timeouts))
 
     # ------------------------------------------------------------------
     # silent-divergence auditing (obs/audit.py; audit=True clusters)
@@ -644,10 +890,7 @@ class SimCluster:
         # from absorbing windows until recovery overwrites them.
         heads = [int(res["head"][r]) for r in range(self.R)
                  if r not in self.need_recovery]
-        if not heads:
-            self._rebase_stalled_step(res)
-            return
-        delta = min(heads) & ~(self.cfg.n_slots - 1)
+        delta = rebase_delta_of(heads, self.cfg.n_slots)
         if delta <= 0:
             self._rebase_stalled_step(res)
             return
@@ -694,8 +937,20 @@ class SimCluster:
             if not todo:
                 return
             starts = jnp.asarray(self.applied.astype(np.int32))
-            wd_all, wm_all = self._fetch_all(self.state.log, starts)
-            wd_all, wm_all = np.asarray(wd_all), np.asarray(wm_all)
+            # bind the fetch's log argument UNDER the host lock: the
+            # pipelined dispatch thread donates the current state
+            # buffers into the next step's dispatch, and a fetch bound
+            # after that donation reads deleted buffers. Binding first
+            # is sufficient — the runtime keeps an argument buffer
+            # alive for an already-enqueued program — and the newer log
+            # is safe to read: committed entries are immutable, the
+            # rollover is deferred while tickets are in flight, and the
+            # M_GIDX integrity check still guards slot recycling. Only
+            # the BIND holds the lock; the blocking result read below
+            # runs outside it so the dispatch path never stalls.
+            with self._host_lock:
+                wd_fut, wm_fut = self._fetch_all(self.state.log, starts)
+            wd_all, wm_all = np.asarray(wd_fut), np.asarray(wm_fut)
             for r in todo:
                 commit = int(res["commit"][r])
                 n = int(min(commit - self.applied[r], W))
@@ -703,30 +958,8 @@ class SimCluster:
                 if n > 0 and int(wm[0, M_GIDX]) != self.applied[r]:
                     self.need_recovery.add(r)       # slot recycled
                     continue
-                # vectorized window decode: one contiguous byte view +
-                # one column read per field (the per-entry scalar
-                # conversions dominated the replay path at high rates)
-                types = wm[:n, M_TYPE]
-                client = ((types >= int(EntryType.CONNECT))
-                          & (types <= int(EntryType.CLOSE)))
-                idxs = np.nonzero(client)[0]
-                if idxs.size:
-                    conns = wm[:n, M_CONN]
-                    reqs = wm[:n, M_REQID]
-                    lens = wm[:n, M_LEN]
-                    raw = np.ascontiguousarray(
-                        wd[:n]).view(np.uint8).reshape(n, -1)
-                    row = raw.shape[1]
-                    buf = raw.tobytes()
-                    rep = self.replayed[r]
-                    for j in idxs:
-                        o = int(j) * row
-                        rep.append((int(types[j]), int(conns[j]),
-                                    int(reqs[j]),
-                                    buf[o:o + int(lens[j])]))
-                    if self.collect_frames:
-                        self.frames[r].append(assemble_frames(
-                            types, conns, lens, raw, idxs))
+                decode_window(wm, wd, n, self.replayed[r],
+                              self.frames[r], self.collect_frames)
                 self.applied[r] += n
 
     # ---------------- inspection ----------------

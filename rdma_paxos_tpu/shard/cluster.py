@@ -31,7 +31,9 @@ election timeouts.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
+import threading
 from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
@@ -46,9 +48,10 @@ from rdma_paxos_tpu.consensus.step import StepInput, fetch_window
 from rdma_paxos_tpu.parallel.mesh import (
     build_sim_group_burst, build_sim_group_step, stack_group_states)
 from rdma_paxos_tpu.runtime.sim import (
-    STEP_CACHE, SimCluster, assemble_frames)
+    STEP_CACHE, SimCluster, StagingPool, StepTicket, assemble_frames,
+    clamp_burst_take, decode_window, pack_rows, rebase_delta_of,
+    requeue_shortfall, require_drained)
 from rdma_paxos_tpu.shard.router import KeyRouter
-from rdma_paxos_tpu.utils.codec import bytes_to_words
 
 # step() result keys pulled to host numpy each dispatch — the same set
 # SimCluster materializes, so per-group slices are drop-in res dicts
@@ -133,8 +136,15 @@ class ShardedCluster:
         self.peer_mask = np.ones((G, R, R), np.int32)
         self.pending: List[List[list]] = [
             [[] for _ in range(R)] for _ in range(G)]
-        self._inflight: List[List[list]] = [
-            [[] for _ in range(R)] for _ in range(G)]
+        # pipelined dispatch (begin_*/finish — same contract as
+        # SimCluster): FIFO of in-flight tickets, staging-buffer pool,
+        # host lock, dispatch-concurrency counters, dispatch clock
+        self._tickets: collections.deque = collections.deque()
+        self._staging = StagingPool()
+        self._host_lock = threading.RLock()
+        self.inflight_dispatches = 0
+        self.max_inflight_dispatches = 0
+        self._dispatch_clock = 0
         self.replayed: List[List[list]] = [
             [[] for _ in range(R)] for _ in range(G)]
         self.last: Optional[Dict[str, np.ndarray]] = None
@@ -170,9 +180,12 @@ class ShardedCluster:
                req_id: int = 0) -> None:
         """Queue a client entry for the next step on ``replica`` of
         ``group`` (it only enters that group's log if the replica is
-        its leader — proxy semantics, per group)."""
-        self.pending[group][replica].append(
-            (int(etype), conn, req_id, payload))
+        its leader — proxy semantics, per group). Locked: a concurrent
+        ``begin_*`` batch take swaps the pending list object, and an
+        unlocked append to the old object would be silently lost."""
+        with self._host_lock:
+            self.pending[group][replica].append(
+                (int(etype), conn, req_id, payload))
 
     def partition(self, group: int,
                   groups_of_replicas: Sequence[Sequence[int]]) -> None:
@@ -210,7 +223,7 @@ class ShardedCluster:
             return self.peer_mask
         mask = self.peer_mask.copy()
         for g, lm in self.link_models.items():
-            mask[g] = lm.effective_mask(mask[g], self.step_index)
+            mask[g] = lm.effective_mask(mask[g], self._dispatch_clock)
         return mask
 
     def _norm_timeouts(self, timeouts: TimeoutsLike) -> Dict[int, list]:
@@ -223,45 +236,29 @@ class ShardedCluster:
             out.setdefault(int(g), []).append(int(r))
         return out
 
-    def _build_inputs(self, tmo_by_group: Dict[int, list]) -> StepInput:
-        cfg, G, R = self.cfg, self.G, self.R
-        mask = self._effective_mask()
-        if self._fanout == "psum" and not mask.all():
-            raise ValueError(
-                "psum fan-out requires full connectivity; use "
-                "fanout='gather' to model partitions")
-        B = cfg.batch_slots
-        data = np.zeros((G, R, B, cfg.slot_words), np.int32)
-        meta = np.zeros((G, R, B, META_W), np.int32)
-        count = np.zeros((G, R), np.int32)
-        qdepth = np.zeros((G, R), np.int32)
-        for g in range(G):
-            for r in range(R):
-                take = self.pending[g][r][:B]
-                self.pending[g][r] = self.pending[g][r][B:]
-                self._inflight[g][r] = take
-                for i, (t, conn, req, payload) in enumerate(take):
-                    data[g, r, i] = bytes_to_words(payload,
-                                                   cfg.slot_words)
-                    meta[g, r, i, M_TYPE] = t
-                    meta[g, r, i, M_CONN] = conn
-                    meta[g, r, i, M_REQID] = req
-                    meta[g, r, i, M_LEN] = len(payload)
-                count[g, r] = len(take)
-                qdepth[g, r] = len(self.pending[g][r])
-        tmo = np.zeros((G, R), np.int32)
-        for g, rs in tmo_by_group.items():
-            for r in rs:
-                tmo[g, r] = 1
-        return StepInput(
-            batch_data=jnp.asarray(data),
-            batch_meta=jnp.asarray(meta),
-            batch_count=jnp.asarray(count),
-            timeout_fired=jnp.asarray(tmo),
-            peer_mask=jnp.asarray(mask),
-            apply_done=jnp.asarray(self.applied.astype(np.int32)),
-            queue_depth=jnp.asarray(qdepth),
-        )
+    def _step_bufs(self) -> dict:
+        cfg, G, R, B = self.cfg, self.G, self.R, self.cfg.batch_slots
+        return self._staging.acquire(
+            ("gstep", G, R, B), lambda: dict(
+                data=np.zeros((G, R, B, cfg.slot_words), np.int32),
+                meta=np.zeros((G, R, B, META_W), np.int32)))
+
+    def _burst_bufs(self, K: int) -> dict:
+        cfg, G, R, B = self.cfg, self.G, self.R, self.cfg.batch_slots
+        return self._staging.acquire(
+            ("gburst", K, G, R, B), lambda: dict(
+                data=np.zeros((K, G, R, B, cfg.slot_words), np.int32),
+                meta=np.zeros((K, G, R, B, META_W), np.int32)))
+
+    def reserved_appends(self) -> np.ndarray:
+        """[G, R] appends dispatched but not yet finished (pipelined
+        capacity reservation — same rule as SimCluster)."""
+        out = np.zeros((self.G, self.R), np.int64)
+        for t in self._tickets:
+            for g in range(self.G):
+                for r in range(self.R):
+                    out[g, r] += len(t.taken[g][r])
+        return out
 
     def _build_step(self, *, elections: bool):
         """Fetch (or compile once into the SHARED runtime cache) the
@@ -322,16 +319,58 @@ class ShardedCluster:
                jnp.zeros((K, G, R), jnp.int32), pm, ap,
                jnp.zeros((G, R), jnp.int32))
 
-    def step(self, timeouts: TimeoutsLike = ()) -> Dict[str, np.ndarray]:
-        """One protocol step for EVERY group in one device dispatch.
-        ``timeouts`` fires election timers per group: a dict
-        ``{group: [replica, ...]}`` or an iterable of ``(group,
-        replica)`` pairs. Returns ``[G, R]`` result arrays."""
+    def begin_step(self, timeouts: TimeoutsLike = (),
+                   take_batch: bool = True) -> StepTicket:
+        """Encode + DISPATCH one protocol step for EVERY group in one
+        device dispatch; returns the in-flight ticket immediately
+        (pass to :meth:`finish`, FIFO — same pipelining contract as
+        ``SimCluster.begin_step``). ``timeouts`` fires election timers
+        per group: a dict ``{group: [replica, ...]}`` or an iterable
+        of ``(group, replica)`` pairs."""
+        cfg, G, R, B = self.cfg, self.G, self.R, self.cfg.batch_slots
         prof = self.profiler
         if prof is not None:
             prof.start("host_encode")
         tmo = self._norm_timeouts(timeouts)
-        inp = self._build_inputs(tmo)
+        mask = self._effective_mask()
+        if self._fanout == "psum" and not mask.all():
+            raise ValueError(
+                "psum fan-out requires full connectivity; use "
+                "fanout='gather' to model partitions")
+        bufs = self._step_bufs()
+        count = np.zeros((G, R), np.int32)
+        qdepth = np.zeros((G, R), np.int32)
+        with self._host_lock:
+            taken: List[List[list]] = [[[] for _ in range(R)]
+                                       for _ in range(G)]
+            for g in range(G):
+                for r in range(R):
+                    take = (self.pending[g][r][:B] if take_batch
+                            else [])
+                    if take:
+                        self.pending[g][r] = self.pending[g][r][B:]
+                    taken[g][r] = take
+                    qdepth[g, r] = len(self.pending[g][r])
+            applied = self.applied.astype(np.int32)
+        for g in range(G):
+            for r in range(R):
+                take = taken[g][r]
+                if take:
+                    pack_rows(bufs, (g, r), take, cfg.slot_bytes)
+                    count[g, r] = len(take)
+        tmo_arr = np.zeros((G, R), np.int32)
+        for g, rs in tmo.items():
+            for r in rs:
+                tmo_arr[g, r] = 1
+        inp = StepInput(
+            batch_data=jnp.asarray(bufs["data"]),
+            batch_meta=jnp.asarray(bufs["meta"]),
+            batch_count=jnp.asarray(count),
+            timeout_fired=jnp.asarray(tmo_arr),
+            peer_mask=jnp.asarray(mask),
+            apply_done=jnp.asarray(applied),
+            queue_depth=jnp.asarray(qdepth),
+        )
         # no timer fired in ANY group ⟹ Phase B is provably a no-op
         # for every group: dispatch the stable step (bit-identical)
         if self._stable_fast_path and not tmo:
@@ -341,45 +380,186 @@ class ShardedCluster:
         if prof is not None:
             prof.stop("host_encode")
             prof.start("device_dispatch")
-        self.state, out = fn(self.state, inp)
+        with self._host_lock:
+            self.state, out = fn(self.state, inp)
+            ticket = StepTicket("step", out, taken, tmo, 1, bufs)
+            self._tickets.append(ticket)
+            self.inflight_dispatches += 1
+            self.max_inflight_dispatches = max(
+                self.max_inflight_dispatches, self.inflight_dispatches)
         if prof is not None:
             prof.stop("device_dispatch")
-            prof.sync(out)              # fenced device_sync (opt-in)
-            prof.start("quorum_wait")
         self.dispatches += 1
         self.programs_used.add(key)
-        res = {k: np.asarray(getattr(out, k)) for k in _RES_KEYS}
+        self._dispatch_clock += 1
+        return ticket
+
+    def begin_burst(self) -> StepTicket:
+        """Encode + DISPATCH up to ``max(K_TIERS)`` fused protocol
+        steps for every group; returns the in-flight ticket. Capacity
+        sizing subtracts appends reserved by other in-flight tickets
+        (the pipelined clamp rule — see SimCluster.begin_burst)."""
+        cfg, G, R, B = self.cfg, self.G, self.R, self.cfg.batch_slots
+        assert self.last is not None, "burst requires a stepped cluster"
+        prof = self.profiler
+        if prof is not None:
+            prof.start("host_encode")
+        mask = self._effective_mask()
+        if self._fanout == "psum" and not mask.all():
+            raise ValueError(
+                "psum fan-out requires full connectivity; use "
+                "fanout='gather' to model partitions")
+        take_n = np.zeros((G, R), np.int64)
+        qdepth = np.zeros((G, R), np.int32)
+        taken: List[List[list]] = [[[] for _ in range(R)]
+                                   for _ in range(G)]
+        with self._host_lock:
+            reserved = self.reserved_appends()
+            last = self.last
+            for g in range(G):
+                for r in range(R):
+                    n = clamp_burst_take(
+                        len(self.pending[g][r]),
+                        int(last["end"][g, r]), int(last["head"][g, r]),
+                        cfg.n_slots, self.K_TIERS[-1] * B,
+                        int(reserved[g, r]))
+                    take_n[g, r] = n
+                    taken[g][r] = self.pending[g][r][:n]
+                    self.pending[g][r] = self.pending[g][r][n:]
+                    qdepth[g, r] = len(self.pending[g][r])
+            applied = self.applied.astype(np.int32)
+        k_needed = max(1, int(-(-take_n.max() // B)))
+        K = next(k for k in self.K_TIERS if k >= k_needed)
+        bufs = self._burst_bufs(K)
+        count = np.zeros((K, G, R), np.int32)
+        for g in range(G):
+            for r in range(R):
+                n = int(take_n[g, r])
+                for k in range(-(-n // B) if n else 0):
+                    pack_rows(bufs, (k, g, r),
+                              taken[g][r][k * B:(k + 1) * B],
+                              cfg.slot_bytes)
+                for k in range(K):
+                    count[k, g, r] = max(0, min(n - k * B, B))
+        fn, key = self._burst_fn(K)
+        if prof is not None:
+            prof.stop("host_encode")
+            prof.start("device_dispatch")
+        with self._host_lock:
+            self.state, outs = fn(
+                self.state, jnp.asarray(bufs["data"]),
+                jnp.asarray(bufs["meta"]), jnp.asarray(count),
+                jnp.asarray(mask), jnp.asarray(applied),
+                jnp.asarray(qdepth))
+            ticket = StepTicket("burst", outs, taken, {}, K, bufs)
+            self._tickets.append(ticket)
+            self.inflight_dispatches += 1
+            self.max_inflight_dispatches = max(
+                self.max_inflight_dispatches, self.inflight_dispatches)
+        if prof is not None:
+            prof.stop("device_dispatch")
+        self.dispatches += 1
+        self.programs_used.add(key)
+        self._dispatch_clock += K
+        return ticket
+
+    def finish(self, ticket: StepTicket) -> Dict[str, np.ndarray]:
+        """Block on ``ticket``'s outputs and run every post-step host
+        rule — tickets MUST finish in dispatch order (the same
+        begin/finish contract as ``SimCluster``)."""
+        assert self._tickets and self._tickets[0] is ticket, \
+            "tickets must finish in dispatch (FIFO) order"
+        # NOT popped here — see SimCluster.finish: the ticket stays in
+        # _tickets (counted by reserved_appends) until ``last`` below
+        # reflects its appends, and the deque only mutates under
+        # _host_lock
+        G, R, B = self.G, self.R, self.cfg.batch_slots
+        prof = self.profiler
+        out = ticket.out
+        burst = ticket.kind == "burst"
+        if prof is not None:
+            prof.sync(out)              # fenced device_sync (opt-in)
+            prof.start("quorum_wait")
+        if burst:
+            res = {k: np.asarray(getattr(out, k))[-1]
+                   for k in _RES_KEYS if k != "accepted"}
+            acc = np.asarray(out.accepted).sum(axis=0)       # [G, R]
+            res["accepted"] = acc
+        else:
+            res = {k: np.asarray(getattr(out, k)) for k in _RES_KEYS}
         if prof is not None:
             prof.stop("quorum_wait")
         if self._audit:
-            for k in ("audit_start", "audit_digest", "audit_term"):
-                res[k] = np.asarray(getattr(out, k))
-            self._ingest_audit(res["audit_start"], res["audit_digest"],
-                               res["audit_term"], res["commit"])
-            flight_taken = [[list(t) for t in row]
-                            for row in self._inflight]
-        for g in range(self.G):
-            for r in range(self.R):
-                take = self._inflight[g][r]
-                self._inflight[g][r] = []
-                if take and res["role"][g, r] == int(Role.LEADER):
-                    acc = int(res["accepted"][g, r])
-                    self._stamp_appends(g, r, take, acc, res)
-                    if acc < len(take):
-                        self.pending[g][r] = (take[acc:]
-                                              + self.pending[g][r])
+            if burst:
+                a_s = np.asarray(out.audit_start)      # [K, G, R]
+                a_d = np.asarray(out.audit_digest)     # [K, G, R, W]
+                a_t = np.asarray(out.audit_term)       # [K, G, R, W]
+                a_c = np.asarray(out.commit)           # [K, G, R]
+                for k in range(a_s.shape[0]):
+                    self._ingest_audit(a_s[k], a_d[k], a_t[k], a_c[k])
+                res["audit_start"] = a_s[-1]
+                res["audit_digest"] = a_d[-1]
+                res["audit_term"] = a_t[-1]
+            else:
+                for k in ("audit_start", "audit_digest", "audit_term"):
+                    res[k] = np.asarray(getattr(out, k))
+                self._ingest_audit(res["audit_start"],
+                                   res["audit_digest"],
+                                   res["audit_term"], res["commit"])
+        with self._host_lock:
+            for g in range(G):
+                for r in range(R):
+                    take = ticket.taken[g][r]
+                    if take and res["role"][g, r] == int(Role.LEADER):
+                        acc_gr = int(res["accepted"][g, r])
+                        self._stamp_appends(g, r, take, acc_gr, res)
+                        requeue_shortfall(self.pending[g][r], take,
+                                          acc_gr)
         if prof is not None:
             prof.start("apply")
         self._replay_committed(res)
         if prof is not None:
             prof.stop("apply")
         if self._audit:
-            self._record_flight(res, flight_taken, tmo)
-        self._maybe_rebase(res)
-        self.last = res
-        self.step_index += 1
+            self._record_flight(res, ticket.taken, ticket.timeouts,
+                                burst_k=ticket.K)
+        with self._host_lock:
+            self._tickets.popleft()     # retire: last now covers it
+            self.inflight_dispatches -= 1
+            # the per-group i32 rollover rewrites offsets host-side:
+            # deferred while dispatches are in flight (see SimCluster)
+            if not self._tickets:
+                self._maybe_rebase(res)
+            self.last = res
+        self.step_index += ticket.K
         self._observe(res)
+        if burst:
+            self._staging.release(ticket.bufs, [
+                ((k, g, r), min(B, len(t) - k * B))
+                for g in range(G) for r in range(R)
+                for t in (ticket.taken[g][r],)
+                for k in range(-(-len(t) // B) if t else 0)])
+        else:
+            self._staging.release(ticket.bufs, [
+                ((g, r), len(ticket.taken[g][r]))
+                for g in range(G) for r in range(R)])
         return res
+
+    def drain(self) -> Optional[Dict[str, np.ndarray]]:
+        """Finish every in-flight ticket in order; returns the final
+        result (or None when nothing was in flight)."""
+        res = None
+        while self._tickets:
+            res = self.finish(self._tickets[0])
+        return res
+
+    def step(self, timeouts: TimeoutsLike = ()) -> Dict[str, np.ndarray]:
+        """One protocol step for EVERY group in one device dispatch.
+        ``timeouts`` fires election timers per group: a dict
+        ``{group: [replica, ...]}`` or an iterable of ``(group,
+        replica)`` pairs. Returns ``[G, R]`` result arrays."""
+        require_drained(self._tickets, "step")
+        return self.finish(self.begin_step(timeouts))
 
     def step_burst(self) -> Dict[str, np.ndarray]:
         """Drain every group's pending queues through up to
@@ -387,101 +567,8 @@ class ShardedCluster:
         Same contract as ``SimCluster.step_burst`` per group: no
         elections fire inside the burst; the caller must only burst
         while every trafficked group has a known leader."""
-        cfg, G, R, B = self.cfg, self.G, self.R, self.cfg.batch_slots
-        assert self.last is not None, "burst requires a stepped cluster"
-        prof = self.profiler
-        if prof is not None:
-            prof.start("host_encode")
-        take_n = np.zeros((G, R), np.int64)
-        for g in range(G):
-            for r in range(R):
-                avail = ((cfg.n_slots - 1)
-                         - (int(self.last["end"][g, r])
-                            - int(self.last["head"][g, r])))
-                take_n[g, r] = min(len(self.pending[g][r]),
-                                   max(avail, 0), self.K_TIERS[-1] * B)
-        k_needed = max(1, int(-(-take_n.max() // B)))
-        K = next(k for k in self.K_TIERS if k >= k_needed)
-
-        data = np.zeros((K, G, R, B, cfg.slot_words), np.int32)
-        meta = np.zeros((K, G, R, B, META_W), np.int32)
-        count = np.zeros((K, G, R), np.int32)
-        qdepth = np.zeros((G, R), np.int32)
-        taken: List[List[list]] = [[[] for _ in range(R)]
-                                   for _ in range(G)]
-        for g in range(G):
-            for r in range(R):
-                n = int(take_n[g, r])
-                take = self.pending[g][r][:n]
-                self.pending[g][r] = self.pending[g][r][n:]
-                taken[g][r] = take
-                for i, (t, conn, req, payload) in enumerate(take):
-                    k, j = divmod(i, B)
-                    data[k, g, r, j] = bytes_to_words(payload,
-                                                      cfg.slot_words)
-                    meta[k, g, r, j, M_TYPE] = t
-                    meta[k, g, r, j, M_CONN] = conn
-                    meta[k, g, r, j, M_REQID] = req
-                    meta[k, g, r, j, M_LEN] = len(payload)
-                for k in range(K):
-                    count[k, g, r] = max(0, min(n - k * B, B))
-                qdepth[g, r] = len(self.pending[g][r])
-
-        mask = self._effective_mask()
-        if self._fanout == "psum" and not mask.all():
-            raise ValueError(
-                "psum fan-out requires full connectivity; use "
-                "fanout='gather' to model partitions")
-        fn, key = self._burst_fn(K)
-        if prof is not None:
-            prof.stop("host_encode")
-            prof.start("device_dispatch")
-        self.state, outs = fn(
-            self.state, jnp.asarray(data), jnp.asarray(meta),
-            jnp.asarray(count), jnp.asarray(mask),
-            jnp.asarray(self.applied.astype(np.int32)),
-            jnp.asarray(qdepth))
-        if prof is not None:
-            prof.stop("device_dispatch")
-            prof.sync(outs)             # fenced device_sync (opt-in)
-            prof.start("quorum_wait")
-        self.dispatches += 1
-        self.programs_used.add(key)
-        res = {k: np.asarray(getattr(outs, k))[-1]
-               for k in _RES_KEYS if k != "accepted"}
-        acc = np.asarray(outs.accepted).sum(axis=0)          # [G, R]
-        res["accepted"] = acc
-        if prof is not None:
-            prof.stop("quorum_wait")
-        if self._audit:
-            a_s = np.asarray(outs.audit_start)      # [K, G, R]
-            a_d = np.asarray(outs.audit_digest)     # [K, G, R, W]
-            a_t = np.asarray(outs.audit_term)       # [K, G, R, W]
-            a_c = np.asarray(outs.commit)           # [K, G, R]
-            for k in range(a_s.shape[0]):
-                self._ingest_audit(a_s[k], a_d[k], a_t[k], a_c[k])
-            res["audit_start"], res["audit_digest"] = a_s[-1], a_d[-1]
-            res["audit_term"] = a_t[-1]
-        for g in range(G):
-            for r in range(R):
-                if taken[g][r] and res["role"][g, r] == int(Role.LEADER):
-                    a = int(acc[g, r])
-                    self._stamp_appends(g, r, taken[g][r], a, res)
-                    if a < len(taken[g][r]):
-                        self.pending[g][r] = (taken[g][r][a:]
-                                              + self.pending[g][r])
-        if prof is not None:
-            prof.start("apply")
-        self._replay_committed(res)
-        if prof is not None:
-            prof.stop("apply")
-        if self._audit:
-            self._record_flight(res, taken, {}, burst_k=K)
-        self._maybe_rebase(res)
-        self.last = res
-        self.step_index += K
-        self._observe(res)
-        return res
+        require_drained(self._tickets, "step_burst")
+        return self.finish(self.begin_burst())
 
     # ---------------- host apply / rebase ----------------
 
@@ -507,9 +594,12 @@ class ShardedCluster:
             if not todo:
                 break
             starts = jnp.asarray(self.applied.astype(np.int32))
-            wd_all, wm_all = self._fetch_all(self.state.log, starts)
+            # bind under the host lock (donation hazard — see
+            # SimCluster._replay_committed); block on results outside it
+            with self._host_lock:
+                wd_fut, wm_fut = self._fetch_all(self.state.log, starts)
             self.fetch_dispatches += 1
-            wd_all, wm_all = np.asarray(wd_all), np.asarray(wm_all)
+            wd_all, wm_all = np.asarray(wd_fut), np.asarray(wm_fut)
             for g, r in todo:
                 t0 = _time.perf_counter_ns()
                 commit = int(res["commit"][g, r])
@@ -518,27 +608,8 @@ class ShardedCluster:
                 if n > 0 and int(wm[0, M_GIDX]) != self.applied[g, r]:
                     self.need_recovery.add((g, r))
                     continue
-                types = wm[:n, M_TYPE]
-                client = ((types >= int(EntryType.CONNECT))
-                          & (types <= int(EntryType.CLOSE)))
-                idxs = np.nonzero(client)[0]
-                if idxs.size:
-                    conns = wm[:n, M_CONN]
-                    reqs = wm[:n, M_REQID]
-                    lens = wm[:n, M_LEN]
-                    raw = np.ascontiguousarray(
-                        wd[:n]).view(np.uint8).reshape(n, -1)
-                    row = raw.shape[1]
-                    buf = raw.tobytes()
-                    rep = self.replayed[g][r]
-                    for j in idxs:
-                        o = int(j) * row
-                        rep.append((int(types[j]), int(conns[j]),
-                                    int(reqs[j]),
-                                    buf[o:o + int(lens[j])]))
-                    if self.collect_frames:
-                        self.frames[g][r].append(assemble_frames(
-                            types, conns, lens, raw, idxs))
+                decode_window(wm, wd, n, self.replayed[g][r],
+                              self.frames[g][r], self.collect_frames)
                 self.applied[g, r] += n
                 t_group[g] = (t_group.get(g, 0)
                               + _time.perf_counter_ns() - t0)
@@ -583,10 +654,7 @@ class ShardedCluster:
                 continue
             heads = [int(res["head"][g, r]) for r in range(self.R)
                      if (g, r) not in self.need_recovery]
-            if not heads:
-                self._rebase_stalled_step(g, res)
-                continue
-            delta = min(heads) & ~(self.cfg.n_slots - 1)
+            delta = rebase_delta_of(heads, self.cfg.n_slots)
             if delta <= 0:
                 self._rebase_stalled_step(g, res)
                 continue

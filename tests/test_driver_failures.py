@@ -101,11 +101,15 @@ def test_poll_loop_crash_releases_and_rejects_events():
     ev = handler(3, conn, b"blocked-op")
     assert ev is not None and not isinstance(ev, int)
 
-    # poison the next cluster step, then run the loop
+    # poison the next cluster step, then run the loop (all four entry
+    # points: the pipelined loop dispatches via begin_*, the serial
+    # path via step/step_burst)
     def boom(*a, **k):
         raise RuntimeError("injected step failure")
     d.cluster.step = boom
     d.cluster.step_burst = boom
+    d.cluster.begin_step = boom
+    d.cluster.begin_burst = boom
     d.run()
     assert ev.done.wait(10), "blocked event never released"
     assert ev.status == -1
