@@ -188,16 +188,6 @@ def main():
         if args.json:
             fwd += ["--json", args.json]
         return shard_main(fwd)
-    if sharded_e2e and (args.trace or args.trace_json):
-        # the one genuinely unsupported pair left: span correlation
-        # keys are group-namespaced in the sharded engine but the
-        # driver's ack path is not wired to them yet — refuse loudly
-        # rather than export a trace whose spans never complete
-        raise SystemExit(
-            "--groups does not support --trace/--trace-json yet "
-            "(sharded span correlation is not wired through the ack "
-            "path); drop the flag or run single-group")
-
     os.environ.setdefault(
         "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/rp_jax_cache")

@@ -32,9 +32,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 
 def run_one(G: int, *, replicas: int, steps: int, payload: int,
-            burst: bool, json_path, cfg=None):
+            burst: bool, json_path, cfg=None, mesh=None,
+            metric="shard_aggregate_committed_ops_per_sec",
+            extra_detail=None):
     """Build, warm, and drive one G-group cluster; returns the result
-    row dict (also emitted as a BENCH: line)."""
+    row dict (also emitted as a BENCH: line). ``mesh=(group_shards,
+    replicas)`` runs the MULTI-CHIP engine — state sharded over a real
+    2-D ``(group, replica)`` device mesh instead of one device."""
     from benchmarks.reporting import emit
     from rdma_paxos_tpu.config import LogConfig
     from rdma_paxos_tpu.obs import Observability
@@ -43,7 +47,7 @@ def run_one(G: int, *, replicas: int, steps: int, payload: int,
     if cfg is None:
         cfg = LogConfig(n_slots=2048, slot_bytes=128,
                         window_slots=256, batch_slots=256)
-    sc = ShardedCluster(cfg, replicas, G)
+    sc = ShardedCluster(cfg, replicas, G, mesh=mesh)
     sc.obs = Observability()
     targets = sc.place_leaders()
     B = cfg.batch_slots
@@ -82,24 +86,27 @@ def run_one(G: int, *, replicas: int, steps: int, payload: int,
                  for g in range(G)]
     committed = sum(per_group)
     dispatches = sc.dispatches - d0
-    row = emit(
-        "shard_aggregate_committed_ops_per_sec",
-        round(committed / dt, 1), "ops/s",
-        detail=dict(
-            groups=G, replicas=replicas, steps=steps,
-            driver=("burst" if burst else "step"),
-            seconds=round(dt, 3),
-            committed_total=committed,
-            committed_per_group=per_group,
-            leaders=targets,
-            protocol_dispatches=dispatches,
-            dispatch_per_step=round(dispatches
-                                    / max(n_dispatch_steps, 1), 3),
-            replay_fetch_dispatches=sc.fetch_dispatches - f0,
-            compiled_programs_used=len(sc.programs_used),
-        ),
-        obs=sc.obs, json_path=json_path)
-    print(f"  G={G}: {committed} committed in {dt:.2f}s -> "
+    detail = dict(
+        groups=G, replicas=replicas, steps=steps,
+        driver=("burst" if burst else "step"),
+        engine=("mesh" if mesh is not None else "single-device"),
+        seconds=round(dt, 3),
+        committed_total=committed,
+        committed_per_group=per_group,
+        leaders=targets,
+        protocol_dispatches=dispatches,
+        dispatch_per_step=round(dispatches
+                                / max(n_dispatch_steps, 1), 3),
+        replay_fetch_dispatches=sc.fetch_dispatches - f0,
+        compiled_programs_used=len(sc.programs_used),
+    )
+    if extra_detail:
+        detail.update(extra_detail)
+    row = emit(metric, round(committed / dt, 1), "ops/s",
+               detail=detail, obs=sc.obs, json_path=json_path)
+    label = (f"{mesh[0]}x{mesh[1]} mesh, G={G}" if mesh is not None
+             else f"G={G}")
+    print(f"  {label}: {committed} committed in {dt:.2f}s -> "
           f"{committed / dt:.0f} ops/s aggregate; "
           f"{dispatches} dispatches / {n_dispatch_steps} steps = "
           f"{dispatches / max(n_dispatch_steps, 1):.2f} per step; "
@@ -107,11 +114,79 @@ def run_one(G: int, *, replicas: int, steps: int, payload: int,
     return row
 
 
+def run_mesh_sweep(layouts, *, groups_per_shard: int, steps: int,
+                   payload: int, burst: bool, json_path) -> int:
+    """The multi-chip layout sweep: each ``GSxR`` layout runs G =
+    GS * groups_per_shard groups over a real ``(group, replica)``
+    device mesh of GS*R devices, A/B'd against a SINGLE-chip baseline
+    carrying the same per-shard load (groups_per_shard groups, the
+    vmap engine). ``scaling_efficiency`` is the headline row:
+    aggregate ÷ (GS × single-chip baseline aggregate) — 1.0 means
+    every added device row contributed a full chip's worth of
+    committed ops/s (near-linear scale-out in chips)."""
+    import jax
+
+    from benchmarks.reporting import emit
+
+    n_dev = len(jax.devices())
+    print(f"shard_bench mesh sweep: layouts {layouts}, "
+          f"{groups_per_shard} group(s)/shard, {steps} steps, "
+          f"driver={'burst' if burst else 'step'}, "
+          f"{n_dev} devices available")
+    baselines = {}          # R -> single-chip aggregate ops/s
+    summary = {}
+    for gs, R in layouts:
+        if gs * R > n_dev:
+            print(f"  {gs}x{R}: SKIPPED (needs {gs * R} devices, "
+                  f"have {n_dev})")
+            continue
+        if R not in baselines:
+            base = run_one(
+                groups_per_shard, replicas=R, steps=steps,
+                payload=payload, burst=burst, json_path=json_path,
+                metric="mesh_baseline_committed_ops_per_sec",
+                extra_detail=dict(role="single-chip baseline"))
+            baselines[R] = base["value"]
+        row = run_one(
+            gs * groups_per_shard, replicas=R, steps=steps,
+            payload=payload, burst=burst, json_path=json_path,
+            mesh=(gs, R),
+            metric="mesh_aggregate_committed_ops_per_sec",
+            extra_detail=dict(layout=f"{gs}x{R}", group_shards=gs,
+                              devices=gs * R))
+        eff = row["value"] / max(gs * baselines[R], 1e-9)
+        emit("mesh_scaling_efficiency", round(eff, 3), "ratio",
+             detail=dict(
+                 layout=f"{gs}x{R}", group_shards=gs, replicas=R,
+                 devices=gs * R, groups=gs * groups_per_shard,
+                 aggregate_ops_per_sec=row["value"],
+                 baseline_single_chip_ops_per_sec=baselines[R],
+                 dispatch_per_step=row["detail"]["dispatch_per_step"],
+                 driver=("burst" if burst else "step")),
+             json_path=json_path)
+        print(f"  {gs}x{R}: scaling efficiency {eff:.2f} "
+              f"({row['value']:.0f} / ({gs} x {baselines[R]:.0f}))")
+        summary[f"{gs}x{R}"] = dict(
+            ops_per_sec=row["value"], scaling_efficiency=round(eff, 3),
+            dispatch_per_step=row["detail"]["dispatch_per_step"])
+    if not summary:
+        # every layout was skipped: the artifact would carry no mesh
+        # data — fail the run instead of handing CI a green no-op
+        print(f"mesh sweep: NO layout fits the {n_dev} available "
+              f"device(s) — nothing measured")
+        return 1
+    emit("mesh_scaling", detail=summary, json_path=json_path)
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--groups", default="1,2,4,8",
-                    help="comma-separated group counts to sweep")
-    ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--groups", default=None,
+                    help="comma-separated group counts to sweep "
+                         "(default 1,2,4,8; incompatible with --mesh)")
+    ap.add_argument("--replicas", type=int, default=None,
+                    help="replication factor (default 3; in --mesh "
+                         "mode R comes from each GSxR layout token)")
     ap.add_argument("--steps", type=int, default=60,
                     help="timed protocol steps per group count")
     ap.add_argument("--payload", type=int, default=64,
@@ -119,6 +194,16 @@ def main(argv=None) -> int:
     ap.add_argument("--burst", action="store_true",
                     help="drive with fused multi-step bursts "
                          "(step_burst) instead of single steps")
+    ap.add_argument("--mesh", default=None,
+                    help='multi-chip sweep: comma-separated device-'
+                         'mesh layouts "GSxR" (e.g. "1x2,2x2,4x2") — '
+                         'each runs G = GS * --groups-per-shard '
+                         'groups over a real (group, replica) mesh of '
+                         'GS*R devices, emitting aggregate ops/s + '
+                         'scaling_efficiency rows vs a single-chip '
+                         'baseline')
+    ap.add_argument("--groups-per-shard", type=int, default=1,
+                    help="groups per device row in --mesh mode")
     ap.add_argument("--json", default=None,
                     help="append JSON result rows to this file")
     args = ap.parse_args(argv)
@@ -132,6 +217,35 @@ def main(argv=None) -> int:
 
     from benchmarks.reporting import emit
 
+    if args.mesh:
+        if args.groups is not None or args.replicas is not None:
+            # refuse loudly rather than silently drop: in --mesh mode
+            # G and R come from the layout tokens + --groups-per-shard
+            raise SystemExit(
+                "--mesh is incompatible with --groups/--replicas: "
+                "each GSxR layout fixes R, and G = GS * "
+                "--groups-per-shard")
+        layouts = []
+        for tok in str(args.mesh).split(","):
+            tok = tok.strip()
+            if not tok:
+                continue
+            try:
+                a, b = tok.lower().split("x")
+                layouts.append((int(a), int(b)))
+            except ValueError:
+                raise SystemExit(
+                    f"--mesh: bad layout {tok!r} — expected "
+                    f'comma-separated "GSxR" tokens, e.g. "1x2,2x2,4x2"')
+        return run_mesh_sweep(layouts,
+                              groups_per_shard=args.groups_per_shard,
+                              steps=args.steps, payload=args.payload,
+                              burst=args.burst, json_path=args.json)
+
+    if args.groups is None:
+        args.groups = "1,2,4,8"
+    if args.replicas is None:
+        args.replicas = 3
     gs = [int(g) for g in str(args.groups).split(",") if g]
     print(f"shard_bench: G sweep {gs}, R={args.replicas}, "
           f"{args.steps} steps, "

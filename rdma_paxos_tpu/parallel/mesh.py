@@ -28,6 +28,7 @@ from rdma_paxos_tpu.consensus.state import ReplicaState, make_replica_state
 from rdma_paxos_tpu.consensus.step import StepInput, replica_step
 
 REPLICA_AXIS = "replica"
+GROUP_AXIS = "group"
 
 
 def _shard_map(f, *, mesh: Mesh, in_specs, out_specs):
@@ -54,6 +55,35 @@ def make_replica_mesh(n_replicas: int,
             f"need {n_replicas} devices, have {len(devs)}")
     import numpy as np
     return Mesh(np.array(devs), (REPLICA_AXIS,))
+
+
+def build_mesh_2d(group_shards: int, replicas: int,
+                  devices: Optional[Sequence] = None) -> Mesh:
+    """2-D device mesh ``(group, replica)`` — the multi-chip layout of
+    the sharded cluster. Groups are sharded across the ``group`` device
+    axis (each device row owns ``G / group_shards`` whole groups);
+    every replica-axis collective of the protocol step (the quorum
+    gathers / psum fan-out) is named on the ``replica`` axis, so no
+    collective ever crosses the group axis — the ICI traffic of G
+    groups is G *independent* R-chip rings, exactly the fault/perf
+    isolation the host layer assumes. Uses ``group_shards * replicas``
+    devices."""
+    need = int(group_shards) * int(replicas)
+    devs = list(jax.devices() if devices is None else devices)
+    if len(devs) < need:
+        raise ValueError(
+            f"need {need} devices for a {group_shards}x{replicas} "
+            f"mesh, have {len(devs)}")
+    import numpy as np
+    return Mesh(np.array(devs[:need]).reshape(group_shards, replicas),
+                (GROUP_AXIS, REPLICA_AXIS))
+
+
+def group_sharding(mesh: Mesh):
+    """The ``NamedSharding`` placing ``[group, replica, ...]`` state
+    pytrees on a :func:`build_mesh_2d` mesh."""
+    from jax.sharding import NamedSharding
+    return NamedSharding(mesh, P(GROUP_AXIS, REPLICA_AXIS))
 
 
 def stack_states(cfg: LogConfig, n_replicas: int, group_size: int
@@ -259,6 +289,102 @@ def build_sim_group_burst(cfg: LogConfig, n_replicas: int, *,
             return gstep(st, inp)
         return lax.scan(body, state_gb, (datas, metas, counts))
     return jax.jit(burst, donate_argnums=(0,) if donate else ())
+
+
+def build_spmd_group_step(cfg: LogConfig, n_replicas: int, mesh: Mesh,
+                          *, use_pallas: bool = False,
+                          interpret: bool = False, donate: bool = True,
+                          fanout: str = "gather",
+                          elections: bool = True, audit: bool = False):
+    """:func:`build_sim_group_step` over a REAL 2-D ``(group,
+    replica)`` device mesh (:func:`build_mesh_2d`): G groups × R
+    replicas advanced by ONE ``shard_map``-compiled dispatch spanning
+    ``group_shards * R`` chips.
+
+    Axis layout: the global ``[G, R, ...]`` pytrees are sharded
+    ``P(group, replica)`` — each device holds ``G / group_shards``
+    whole group rows of exactly one replica column. Inside the
+    per-device program the replica axis (local size 1) is squeezed and
+    the local group rows ride an *unnamed* ``vmap``, so every
+    collective in :func:`replica_step` binds the ``replica`` MESH axis
+    only: quorum traffic crosses the R chips of one replica ring,
+    never the group axis. The compiled program is polymorphic in the
+    local group count, so the cache key carries the mesh — not G
+    (``tests/test_mesh.py`` pins the single-compile property)."""
+    core = functools.partial(
+        replica_step, cfg=cfg, n_replicas=n_replicas,
+        axis_name=REPLICA_AXIS, use_pallas=use_pallas,
+        interpret=interpret, fanout=fanout, elections=elections,
+        audit=audit)
+    vcore = jax.vmap(core, in_axes=(0, 0))      # local groups, unnamed
+
+    def per_device(state_b, inp_b):
+        st, out = vcore(jax.tree.map(lambda x: x[:, 0], state_b),
+                        jax.tree.map(lambda x: x[:, 0], inp_b))
+        return (jax.tree.map(lambda x: x[:, None], st),
+                jax.tree.map(lambda x: x[:, None], out))
+
+    mapped = _shard_map(
+        per_device, mesh=mesh,
+        in_specs=(P(GROUP_AXIS, REPLICA_AXIS),
+                  P(GROUP_AXIS, REPLICA_AXIS)),
+        out_specs=(P(GROUP_AXIS, REPLICA_AXIS),
+                   P(GROUP_AXIS, REPLICA_AXIS)))
+    return jax.jit(mapped, donate_argnums=(0,) if donate else ())
+
+
+def build_spmd_group_burst(cfg: LogConfig, n_replicas: int, mesh: Mesh,
+                           *, use_pallas: bool = False,
+                           interpret: bool = False,
+                           donate: bool = True, fanout: str = "gather",
+                           audit: bool = False):
+    """:func:`build_sim_group_burst` over the 2-D ``(group, replica)``
+    mesh: K fused protocol steps × ALL G groups in ONE multi-chip
+    dispatch (``lax.scan`` of the group-vmapped stable step inside the
+    per-device program). Same contract as the single-device group
+    burst — no elections inside, host apply cursors frozen, capacity
+    sized by the caller — applied per group. Input shapes match
+    :func:`build_sim_group_burst`; K is unsharded, ``[G, R]`` axes are
+    sharded ``P(group, replica)``."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    core = functools.partial(
+        replica_step, cfg=cfg, n_replicas=n_replicas,
+        axis_name=REPLICA_AXIS, use_pallas=use_pallas,
+        interpret=interpret, fanout=fanout, elections=False,
+        audit=audit)
+    vcore = jax.vmap(core, in_axes=(0, 0))      # local groups, unnamed
+
+    def per_device(state_b, datas_b, metas_b, counts_b, peer_b,
+                   applied_b, qdepth_b):
+        st = jax.tree.map(lambda x: x[:, 0], state_b)   # [Gl, ...]
+        zeros_g = jnp.zeros_like(counts_b[0, :, 0])     # [Gl]
+
+        def body(s, xs):
+            d, m, c = xs                # d: [Gl, 1, B, sw] etc.
+            inp = StepInput(
+                batch_data=d[:, 0], batch_meta=m[:, 0],
+                batch_count=c[:, 0], timeout_fired=zeros_g,
+                peer_mask=peer_b[:, 0], apply_done=applied_b[:, 0],
+                queue_depth=qdepth_b[:, 0])
+            return vcore(s, inp)
+        st, outs = lax.scan(body, st, (datas_b, metas_b, counts_b))
+        return (jax.tree.map(lambda x: x[:, None], st),
+                jax.tree.map(lambda x: x[:, :, None], outs))
+
+    mapped = _shard_map(
+        per_device, mesh=mesh,
+        in_specs=(P(GROUP_AXIS, REPLICA_AXIS),
+                  P(None, GROUP_AXIS, REPLICA_AXIS),
+                  P(None, GROUP_AXIS, REPLICA_AXIS),
+                  P(None, GROUP_AXIS, REPLICA_AXIS),
+                  P(GROUP_AXIS, REPLICA_AXIS),
+                  P(GROUP_AXIS, REPLICA_AXIS),
+                  P(GROUP_AXIS, REPLICA_AXIS)),
+        out_specs=(P(GROUP_AXIS, REPLICA_AXIS),
+                   P(None, GROUP_AXIS, REPLICA_AXIS)))
+    return jax.jit(mapped, donate_argnums=(0,) if donate else ())
 
 
 def build_sim_step(cfg: LogConfig, n_replicas: int, *,

@@ -51,7 +51,7 @@ from rdma_paxos_tpu.obs.health import make_snapshot
 from rdma_paxos_tpu.obs.metrics import LATENCY_BUCKETS_S
 from rdma_paxos_tpu.proxy.proxy import PendingEvent
 from rdma_paxos_tpu.runtime.driver import ClusterDriver, conn_origin
-from rdma_paxos_tpu.runtime.timers import ElectionTimer
+from rdma_paxos_tpu.runtime.timers import GroupStepTimer
 from rdma_paxos_tpu.shard.cluster import ShardedCluster
 from rdma_paxos_tpu.shard.router import KeyRouter
 from rdma_paxos_tpu.utils.codec import fragment
@@ -93,7 +93,9 @@ class ShardedClusterDriver(ClusterDriver):
 
     def __init__(self, cfg: LogConfig, n_replicas: int, n_groups: int,
                  *, router: Optional[KeyRouter] = None,
-                 key_of=key_prefix_of, **kw):
+                 key_of=key_prefix_of, mesh=None,
+                 group_timer_lo: int = 6, group_timer_hi: int = 12,
+                 **kw):
         if kw.get("link_model") is not None:
             raise ValueError(
                 "sharded driver: attach per-group link models via "
@@ -102,6 +104,11 @@ class ShardedClusterDriver(ClusterDriver):
         self._router = (router if router is not None
                         else KeyRouter(self.G))
         self._key_of = key_of
+        # mesh=(group_shards, R) or a prebuilt 2-D Mesh routes the
+        # engine onto the multi-chip (group, replica) layout; the
+        # driver's pipelined loop is engine-agnostic (same ticket
+        # contract), so nothing else changes
+        self._mesh = mesh
         # per-group leader views (the sharded analog of _leader_view;
         # _leader_view itself becomes the ALL-GROUPS-LED aggregate so
         # leader()-polling boot code works unchanged)
@@ -115,10 +122,16 @@ class ShardedClusterDriver(ClusterDriver):
             [collections.deque() for _ in range(self.G)]
             for _ in range(n_replicas)]
         self._replay_cursor = [[0] * self.G for _ in range(n_replicas)]
-        # per-group election timers + candidate rotation (group g's
-        # first candidate is replica g % R — round-robin placement)
-        self._gtimers = [ElectionTimer(self.timeout_cfg,
-                                       seed=7000 + 31 * g)
+        # per-group jittered STEP-DOMAIN election timers + candidate
+        # rotation (group g's first candidate is replica g % R, so
+        # converged leaderships land round-robin without any explicit
+        # place_leaders choreography). Deterministic per-(seed, group)
+        # periods: a chaos replay of the same step sequence redraws
+        # identical timings — bit-reproducible, unlike wall clocks.
+        seed = kw.get("seed", 0)
+        self._gtimers = [GroupStepTimer(g, seed=seed,
+                                        lo=group_timer_lo,
+                                        hi=group_timer_hi)
                          for g in range(self.G)]
         self._elect_round = [0] * self.G
 
@@ -126,7 +139,16 @@ class ShardedClusterDriver(ClusterDriver):
                       audit):
         return ShardedCluster(cfg, n_replicas, self.G,
                               router=self._router, fanout=fanout,
-                              group_size=group_size, audit=audit)
+                              group_size=group_size, audit=audit,
+                              mesh=self._mesh)
+
+    def _span_rep(self, g: int, r: int) -> int:
+        """Span-track replica id in the ENGINE's group namespace —
+        delegated to the cluster so driver-side enqueue/ack/fail
+        events land on the same per-group tracks as the engine's
+        append/commit/apply stamps and the ``(group, term, index)``
+        correlation closes end to end."""
+        return self.cluster._span_rep(g, r)
 
     @property
     def router(self) -> KeyRouter:
@@ -192,6 +214,13 @@ class ShardedClusterDriver(ClusterDriver):
                               etype=etype, conn=conn_id, group=g,
                               frags=len(frags),
                               submit_seq=rt.submit_seq)
+        # causal span birth keyed (conn, final fragment seq) — the
+        # pair the per-group ack release matches on; the origin track
+        # is the GROUP-NAMESPACED front-end replica, so the engine's
+        # (group, term, index)-stamped append/commit/apply marks
+        # correlate onto it
+        self.obs.spans.begin(conn_id, rt.submit_seq,
+                             self._span_rep(g, r))
         self._wake.set()
         return ev
 
@@ -236,11 +265,14 @@ class ShardedClusterDriver(ClusterDriver):
             for g in range(self.G):
                 if self._group_views[g] >= 0:
                     continue
-                if self._gtimers[g].expired():
+                # leaderless groups tick their step-domain timer once
+                # per poll iteration; a firing targets the rotation's
+                # next candidate (start at g % R — the round-robin
+                # spread place_leaders used to script explicitly)
+                if self._gtimers[g].tick():
                     cand = (g + self._elect_round[g]) % self.R
                     self._elect_round[g] += 1
                     timeouts[g] = [cand]
-                    self._gtimers[g].beat()
                     self.obs.metrics.inc("election_timeouts_total",
                                          group=g)
         if (not timeouts and c.last is not None
@@ -312,6 +344,9 @@ class ShardedClusterDriver(ClusterDriver):
                 self.obs.trace.record(obs_trace.INFLIGHT_FAILED,
                                       replica=r, group=g, count=n,
                                       site=site)
+                # terminal failover status on the failed waiters'
+                # spans (group-namespaced track) — never leaked
+                self.obs.spans.fail_open(self._span_rep(g, r))
 
     def _fail_inflight_locked(self, rt, site: str) -> None:
         """Fail EVERY group's blocked waiters on this replica (caller
@@ -323,10 +358,11 @@ class ShardedClusterDriver(ClusterDriver):
             rt.log.info_wtime(
                 "APP DIRTY: %d speculated events failed at %s"
                 % (n, site))
-        for dq in self._inflight_g[rt.idx]:
+        for g, dq in enumerate(self._inflight_g[rt.idx]):
             while dq:
                 ev, _ = dq.popleft()
                 ev.release(-1)
+            self.obs.spans.fail_open(self._span_rep(g, rt.idx))
         if n:
             self.obs.metrics.inc("inflight_failed_total", n,
                                  replica=rt.idx)
@@ -400,6 +436,11 @@ class ShardedClusterDriver(ClusterDriver):
                     while dq and dq[0][1] <= own_max:
                         ev, _ = dq.popleft()
                         releases.append(ev)
+                # span acks live on the GROUP-NAMESPACED track the
+                # enqueue-side begin() used — (group, term, index)
+                # correlation closes here
+                self.obs.spans.ack_release(self._span_rep(g, r),
+                                           own_max)
                 self._phase_prof.stop("ack_release")
         if progressed and replaying:
             rt.replay.drain_responses()

@@ -51,6 +51,48 @@ class ElectionTimer:
         self.beat()
 
 
+class GroupStepTimer:
+    """Per-group jittered election timer in the STEP domain — the
+    production sharded driver's replacement for wall-clock
+    ``ElectionTimer`` choreography (and for explicit ``place_leaders``
+    timeout scripting).
+
+    The driver polls in logical steps, so the timer counts polling
+    iterations, not seconds: a leaderless group fires after a jittered
+    ``[lo, hi]`` step period, re-drawn after every firing (randomized-
+    timeout desynchronization, the :class:`ElectionTimer` analog with
+    steps for seconds — the same domain as the chaos harness's
+    ``StepTimerModel``). Seeding is per ``(seed, group)`` through the
+    string-seeded RNG (sha512, PYTHONHASHSEED-independent), so a chaos
+    replay that replays the same step sequence redraws the identical
+    periods — election timing is bit-reproducible where a wall-clock
+    timer would race the scheduler."""
+
+    def __init__(self, group: int, seed: int = 0, lo: int = 6,
+                 hi: int = 12):
+        if not 1 <= lo <= hi:
+            raise ValueError(f"need 1 <= lo <= hi, got [{lo}, {hi}]")
+        self.group = int(group)
+        self.lo, self.hi = int(lo), int(hi)
+        self._rng = random.Random(f"group-timer:{seed}:{group}")
+        self._since = 0
+        self._period = self._rng.randint(self.lo, self.hi)
+
+    def beat(self) -> None:
+        """A heartbeat (the group is led) — reset the countdown."""
+        self._since = 0
+
+    def tick(self) -> bool:
+        """Advance one polling step; True when the timer fires (and
+        the next period is re-jittered)."""
+        self._since += 1
+        if self._since >= self._period:
+            self._since = 0
+            self._period = self._rng.randint(self.lo, self.hi)
+            return True
+        return False
+
+
 class Pacer:
     """Fixed-period pacing for the host polling loop (the libev timer
     cadence: hb_period for leaders doubles as the step cadence here,

@@ -12,6 +12,23 @@ one device (PAPERS.md, arXiv:2503.18093). Device work per step is one
 program of G× the single-group tensor shapes; host work (commit/apply
 frontiers, replay, requeue, rebase, leader tracking) stays per-group.
 
+Two execution engines behind ONE host-bookkeeping implementation:
+
+* ``mesh=None`` (default) — the single-device engine: the group axis
+  is an unnamed ``vmap`` batch axis, all G×R state on one chip.
+* ``mesh=(group_shards, R)`` (or a prebuilt 2-D ``Mesh``) — the
+  MULTI-CHIP engine: state is sharded ``P(group, replica)`` over a
+  real ``(group, replica)`` device mesh
+  (:func:`~rdma_paxos_tpu.parallel.mesh.build_mesh_2d`) and the step
+  compiles via ``shard_map``
+  (:func:`~rdma_paxos_tpu.parallel.mesh.build_spmd_group_step`).
+  Replica collectives bind the ``replica`` mesh axis; nothing crosses
+  the group axis — aggregate committed-ops/s scales with the group
+  shards because each added device row carries whole extra groups
+  (``benchmarks/shard_bench.py --mesh`` measures the scaling
+  efficiency). The ticket contract (``begin_*``/``finish``), replay,
+  rebase, and chaos hooks are byte-for-byte the same host code.
+
 Single-group is the G=1 special case, not a parallel code path: the
 same ``replica_step`` core, the same host bookkeeping rules, the same
 shared compile cache (``runtime/sim.py:STEP_CACHE``) —
@@ -46,7 +63,9 @@ from rdma_paxos_tpu.consensus.log import (
 from rdma_paxos_tpu.consensus.state import Role
 from rdma_paxos_tpu.consensus.step import StepInput, fetch_window
 from rdma_paxos_tpu.parallel.mesh import (
-    build_sim_group_burst, build_sim_group_step, stack_group_states)
+    GROUP_AXIS, REPLICA_AXIS, build_mesh_2d, build_sim_group_burst,
+    build_sim_group_step, build_spmd_group_burst, build_spmd_group_step,
+    group_sharding, stack_group_states)
 from rdma_paxos_tpu.runtime.sim import (
     STEP_CACHE, SimCluster, StagingPool, StepTicket, assemble_frames,
     clamp_burst_take, decode_window, pack_rows, rebase_delta_of,
@@ -88,7 +107,8 @@ class ShardedCluster:
                  interpret: bool = False, fanout: str = "gather",
                  stable_fast_path: bool = True,
                  group_size: Optional[int] = None,
-                 audit: bool = False, flight_capacity: int = 64):
+                 audit: bool = False, flight_capacity: int = 64,
+                 mesh=None):
         if n_groups < 1:
             raise ValueError("n_groups must be >= 1")
         self.cfg = cfg
@@ -103,6 +123,38 @@ class ShardedCluster:
         self._interpret = interpret
         self._fanout = fanout
         self._stable_fast_path = stable_fast_path
+        # mesh engine: a 2-D (group, replica) device mesh — groups
+        # sharded across chips, replica collectives named on the other
+        # axis. None = the single-device vmap engine (unchanged). A
+        # (group_shards, replicas) tuple builds the mesh here; a
+        # prebuilt jax.sharding.Mesh is used as-is. Host bookkeeping is
+        # IDENTICAL either way — only the compiled dispatch differs.
+        if isinstance(mesh, tuple):
+            mesh = build_mesh_2d(*mesh)
+        if mesh is not None:
+            names = tuple(mesh.axis_names)
+            if names != (GROUP_AXIS, REPLICA_AXIS):
+                raise ValueError(
+                    f"mesh axes must be ({GROUP_AXIS!r}, "
+                    f"{REPLICA_AXIS!r}), got {names}")
+            shape = mesh.devices.shape
+            if shape[1] != self.R:
+                raise ValueError(
+                    f"mesh replica axis is {shape[1]} devices but the "
+                    f"cluster has {self.R} replicas (one replica per "
+                    f"chip along the replica axis)")
+            if self.G % shape[0]:
+                raise ValueError(
+                    f"group count {self.G} must divide evenly over "
+                    f"{shape[0]} group shards")
+        self.mesh = mesh
+        self._mode = "sim" if mesh is None else "spmd-group"
+        # cache-key stand-in for the mesh: static device layout only —
+        # deliberately independent of G, so clusters of ANY group
+        # count on one mesh share compiled programs
+        self._mesh_key = (None if mesh is None else
+                          (mesh.devices.shape,
+                           tuple(d.id for d in mesh.devices.flat)))
         # correctness observability (obs/audit.py): per-group digest
         # auditing keyed (group, term, index) — same mechanism as
         # SimCluster, widened by the group axis
@@ -117,6 +169,11 @@ class ShardedCluster:
             self.flight = None
         self.state = stack_group_states(cfg, self.G, self.R,
                                         self.group_size)
+        if mesh is not None:
+            # place the stacked state across the mesh up front so the
+            # donated step never pays a layout change mid-serving
+            self.state = jax.device_put(self.state,
+                                        group_sharding(mesh))
         self._step_full = self._build_step(elections=True)
         # compile-count accounting: every shared-cache key this cluster
         # dispatches through (the single-compile guard's witness)
@@ -263,31 +320,43 @@ class ShardedCluster:
     def _build_step(self, *, elections: bool):
         """Fetch (or compile once into the SHARED runtime cache) the
         group-batched step. The cache key carries everything static
-        that shapes the program — and deliberately NOT the group count:
-        the jitted callable is batch-size-polymorphic, so every
-        homogeneous cluster shape shares one entry per variant."""
-        key = (self.cfg, self.R, "sim", self._use_pallas,
-               self._interpret, self._fanout, "group", elections) \
+        that shapes the program — the engine mode and (for the mesh
+        engine) the static device layout — and deliberately NOT the
+        group count: the jitted callable is batch-size-polymorphic, so
+        every homogeneous cluster shape shares one entry per variant
+        (mesh clusters of any G on one mesh included)."""
+        key = (self.cfg, self.R, self._mode, self._mesh_key,
+               self._use_pallas, self._interpret, self._fanout,
+               "group", elections) \
             + (("audit",) if self._audit else ())
         cached = STEP_CACHE.get(key)
         if cached is None:
-            cached = build_sim_group_step(
-                self.cfg, self.R, use_pallas=self._use_pallas,
-                interpret=self._interpret, fanout=self._fanout,
-                elections=elections, audit=self._audit)
+            kw = dict(use_pallas=self._use_pallas,
+                      interpret=self._interpret, fanout=self._fanout,
+                      elections=elections, audit=self._audit)
+            if self.mesh is not None:
+                cached = build_spmd_group_step(self.cfg, self.R,
+                                               self.mesh, **kw)
+            else:
+                cached = build_sim_group_step(self.cfg, self.R, **kw)
             STEP_CACHE[key] = cached
         return cached, key
 
     def _burst_fn(self, K: int):
-        key = (self.cfg, self.R, "sim", self._use_pallas,
-               self._interpret, self._fanout, "group-burst", K) \
+        key = (self.cfg, self.R, self._mode, self._mesh_key,
+               self._use_pallas, self._interpret, self._fanout,
+               "group-burst", K) \
             + (("audit",) if self._audit else ())
         fn = STEP_CACHE.get(key)
         if fn is None:
-            fn = build_sim_group_burst(
-                self.cfg, self.R, use_pallas=self._use_pallas,
-                interpret=self._interpret, fanout=self._fanout,
-                audit=self._audit)
+            kw = dict(use_pallas=self._use_pallas,
+                      interpret=self._interpret, fanout=self._fanout,
+                      audit=self._audit)
+            if self.mesh is not None:
+                fn = build_spmd_group_burst(self.cfg, self.R,
+                                            self.mesh, **kw)
+            else:
+                fn = build_sim_group_burst(self.cfg, self.R, **kw)
             STEP_CACHE[key] = fn
         return fn, key
 
@@ -703,6 +772,12 @@ class ShardedCluster:
             cfg_src=jnp.where(state.cfg_src >= 0,
                               state.cfg_src - d_gr, state.cfg_src),
         )
+        if self.mesh is not None:
+            # the eager elementwise pass may leave drifted shardings;
+            # re-place so the next donated dispatch pays no reshard
+            # (rebases are rare — deferred until the pipeline drains)
+            self.state = jax.device_put(self.state,
+                                        group_sharding(self.mesh))
 
     # ---------------- observability ----------------
 
@@ -832,6 +907,12 @@ class ShardedCluster:
             groups.append(make_snapshot(**fields))
         return dict(schema=1, n_groups=self.G, n_replicas=self.R,
                     dispatches=self.dispatches,
+                    engine=self._mode,
+                    mesh=(None if self.mesh is None else
+                          dict(layout="%dx%d" % self.mesh.devices.shape,
+                               group_shards=int(self.mesh.devices.shape[0]),
+                               devices=[int(d.id)
+                                        for d in self.mesh.devices.flat])),
                     router=self.router.to_dict(), groups=groups,
                     audit=(self.auditor.summary()
                            if self.auditor is not None else None))
