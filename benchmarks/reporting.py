@@ -73,9 +73,15 @@ def phase_accumulate(driver, pre: dict, agg: dict) -> dict:
     """Fold the accumulator's delta since ``pre`` into ``agg``
     (``{phase: {n, total_us}}``). The profiler accumulator is global,
     so emitting it raw would blend measurement windows — every A/B
-    variant must carry only its own rounds' attribution."""
+    variant must carry only its own rounds' attribution. Phases with a
+    ZERO delta are suppressed (never seeded into ``agg``): a phase
+    that did not run in this window — ``device_sync`` with ``fence=``
+    off, ``ack_release`` in a round with no acks — must not emit a
+    dead n=0 column into the A/B detail rows."""
     for p, (n1, t1) in phase_snapshot(driver).items():
         n0, t0 = pre.get(p, (0, 0.0))
+        if n1 - n0 <= 0 and p not in agg:
+            continue
         row = agg.setdefault(p, dict(n=0, total_us=0.0))
         row["n"] += n1 - n0
         row["total_us"] = round(row["total_us"] + (t1 - t0), 1)

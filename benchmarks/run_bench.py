@@ -28,17 +28,18 @@ NATIVE = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "native")
 
 
-def measure_audit_overhead(cfg=None, *, n_replicas=3, steps=300,
-                           per_step=8, payload=64, warmup=10,
-                           repeats=3):
-    """A/B the compiled-step digest chain: drive the identical
-    closed-loop workload through an audit-off and an audit-on
+def _measure_flag_overhead(flag, proof, cfg=None, *, n_replicas=3,
+                           steps=300, per_step=8, payload=64,
+                           warmup=10, repeats=3):
+    """The shared compiled-step-flag A/B harness: drive the identical
+    closed-loop workload through a flag-off and a flag-on
     ``SimCluster`` and compare committed-entry throughput. The two
     variants run ALTERNATING for ``repeats`` rounds and each variant
     scores its fastest round (host-load noise on a shared machine
-    easily exceeds the effect being measured). Returns
-    ``{"off": {...}, "on": {...}, "overhead_pct": ...}`` (the <5%
-    acceptance target for the ``--audit`` bench row)."""
+    easily exceeds the effect being measured). ``proof(on_cluster,
+    out)`` attaches the flag-specific evidence the row carries.
+    Returns ``{"off": {...}, "on": {...}, "overhead_pct": ...}`` (the
+    <5% acceptance target the overhead bench rows share)."""
     import time as _t
 
     from rdma_paxos_tpu.config import LogConfig
@@ -51,7 +52,7 @@ def measure_audit_overhead(cfg=None, *, n_replicas=3, steps=300,
     clusters = {}
     for variant in ("off", "on"):
         c = SimCluster(cfg, n_replicas, fanout="psum",
-                       audit=(variant == "on"))
+                       **{flag: variant == "on"})
         c.run_until_elected(0)
         for _ in range(warmup):
             c.submit(0, blob)
@@ -73,10 +74,33 @@ def measure_audit_overhead(cfg=None, *, n_replicas=3, steps=300,
             if ops > out[variant]["ops_per_sec"]:
                 out[variant] = dict(steps=steps, seconds=round(dt, 4),
                                     committed=done, ops_per_sec=ops)
-    out["audit"] = clusters["on"].auditor.summary()
+    proof(clusters["on"], out)
     off, on = out["off"]["ops_per_sec"], out["on"]["ops_per_sec"]
     out["overhead_pct"] = round((off - on) / off * 100, 2)
     return out
+
+
+def measure_audit_overhead(cfg=None, **kw):
+    """A/B the compiled-step digest chain (``audit=``); the proof is
+    the ON cluster's ledger summary — the workload ran digest-checked
+    (the <5% acceptance target for the ``--audit`` bench row)."""
+    def proof(on_c, out):
+        out["audit"] = on_c.auditor.summary()
+    return _measure_flag_overhead("audit", proof, cfg, **kw)
+
+
+def measure_telemetry_overhead(cfg=None, **kw):
+    """A/B the compiled-step device-counter vector (``telemetry=``);
+    the proof is the ON cluster's device-counter totals — the counters
+    flowed (the <5% acceptance target for the ``--telemetry`` bench
+    row)."""
+    def proof(on_c, out):
+        from rdma_paxos_tpu.obs import device as device_mod
+        out["device_counters"] = {
+            name: [int(v) for v in
+                   on_c.device_counters[:, device_mod.INDEX[name]]]
+            for name in device_mod.NAMES}
+    return _measure_flag_overhead("telemetry", proof, cfg, **kw)
 
 
 def client_worker(port, n, lat, tid, pipeline=1, retries=5):
@@ -173,11 +197,28 @@ def main():
                          "audit ledger + flight recorder + SLO alerts "
                          "during the workload, and emit an "
                          "audit-overhead A/B row (digests on vs off)")
+    ap.add_argument("--telemetry", action="store_true",
+                    help="device telemetry: compile the counter-vector "
+                         "step variants (obs/device.py), export "
+                         "device_*{replica=} series during the "
+                         "workload, and emit a telemetry_overhead_pct "
+                         "A/B row (counters on vs off, target <5%%)")
+    ap.add_argument("--profile", action="store_true",
+                    help="bounded jax.profiler capture of the client "
+                         "wave; writes the raw capture, a "
+                         "program_report.json (per-variant flops / "
+                         "bytes / memory), and — with --trace — ONE "
+                         "merged Perfetto timeline: client spans + "
+                         "host phases + device execution on shared "
+                         "clock anchors")
+    ap.add_argument("--profile-secs", type=float, default=60.0,
+                    help="hard bound on the --profile capture")
     args = ap.parse_args()
 
     sharded_e2e = bool(args.groups) and (
         args.e2e or args.fence or args.audit or args.metrics_json
-        or args.threaded_app or args.trace or args.trace_json)
+        or args.threaded_app or args.trace or args.trace_json
+        or args.telemetry or args.profile)
     if args.groups and not sharded_e2e:
         # plain --groups N: the sharded SIM sweep (shard_bench owns its
         # own cluster lifecycle). Any e2e flag routes to the sharded
@@ -207,6 +248,13 @@ def main():
     subprocess.run(["make", "-C", NATIVE], check=True, capture_output=True)
 
     tcfg = TimeoutConfig(elec_timeout_low=0.5, elec_timeout_high=1.0)
+    if args.profile:
+        # the profiler multiplies host + dispatch cost on a shared
+        # box; a 0.5 s election timer reads that as a dead leader and
+        # churns mid-capture — widen so the capture observes SERVING,
+        # not election storms (boot takes a few seconds longer)
+        tcfg = TimeoutConfig(elec_timeout_low=5.0,
+                             elec_timeout_high=8.0)
     if sharded_e2e:
         from rdma_paxos_tpu.runtime.sharded_driver import (
             ShardedClusterDriver)
@@ -214,12 +262,13 @@ def main():
             cfg, args.replicas, args.groups, workdir=wd,
             app_ports=ports, timeout_cfg=tcfg, fanout="psum",
             fence=args.fence, audit=args.audit,
-            pipeline=args.pipeline_depth)
+            telemetry=args.telemetry, pipeline=args.pipeline_depth)
     else:
         driver = ClusterDriver(
             cfg, args.replicas, workdir=wd, app_ports=ports,
             timeout_cfg=tcfg, fanout="psum", fence=args.fence,
-            audit=args.audit, pipeline=args.pipeline_depth)
+            audit=args.audit, telemetry=args.telemetry,
+            pipeline=args.pipeline_depth)
     if args.trace:
         # 100% sampling (the default is rate-limited); capacity sized
         # so a full run's spans are retained for the export
@@ -280,7 +329,17 @@ def main():
         flat.sort()
         return (per_w * args.clients) / dt_w, dt_w, flat
 
+    profile_session = None
+    if args.profile:
+        # host-phase slices feed the merged timeline's middle track;
+        # the device capture is bounded (the poll loop enforces it)
+        driver._phase_prof.enable_events()
+        profile_session = driver.start_profile(
+            seconds=args.profile_secs,
+            log_dir=os.path.join(wd, "profile"))
     ops, dt, lat = run_wave(args.requests)
+    if profile_session is not None:
+        driver.stop_profile()
     nb = len(lat)
     n = args.requests // args.clients * args.clients
     print(f"committed SETs: {n} in {dt:.2f}s -> {n / dt:.0f} ops/s "
@@ -359,10 +418,56 @@ def main():
     from benchmarks.reporting import emit
 
     def phase_sums():
-        """Per-phase StepPhaseProfiler sums (n / total / max us)."""
-        return {p: dict(n=a[0], total_us=round(a[1], 1),
-                        max_us=round(a[2], 1))
-                for p, a in sorted(driver._phase_prof.acc.items())}
+        """Per-phase StepPhaseProfiler sums — zero-sample phases
+        suppressed (a fence-off run must not carry a dead
+        device_sync column)."""
+        return driver._phase_prof.sums()
+
+    profile_detail = None
+    if args.profile:
+        from rdma_paxos_tpu.obs import device as device_mod
+
+        # per-STEP_CACHE-variant compiled-program cost report: what
+        # one dispatch COSTS, next to what it DID (the counters)
+        report = device_mod.write_program_report(
+            os.path.join(wd, "program_report.json"), driver.cluster,
+            tiers=(2,))
+        emit("program_report", len(report["variants"]), "variants",
+             detail=dict(
+                 path=report["path"], backend=report["backend"],
+                 engine=report["engine"],
+                 variants=[{k: v for k, v in row.items()
+                            if k in ("variant", "flops",
+                                     "bytes_accessed")}
+                           for row in report["variants"]]),
+             obs=driver.obs, json_path=args.json)
+        merged_path = os.path.join(wd, "merged.perfetto.json")
+        # the SAME dump that fed spans.json / trace.perfetto.json —
+        # a second dump() here would capture spans that completed in
+        # between and the three artifacts would disagree
+        span_dumps = [raw] if args.trace else []
+        merged = device_mod.merge_timeline(
+            span_dumps,
+            phase_events=list(driver._phase_prof.events or []),
+            profiler=profile_session, max_cp_tracks=4096)
+        with open(merged_path, "w") as mf:
+            json.dump(merged, mf)
+        profile_detail = dict(
+            merged_perfetto=merged_path,
+            profile_dir=profile_session.log_dir,
+            device_events=merged["otherData"]["device_events"],
+            device_events_dropped=merged["otherData"][
+                "device_events_dropped"],
+            host_phase_events=merged["otherData"]["host_phase_events"],
+            span_tracks=merged["otherData"]["spans"],
+            program_report=report["path"])
+        print(f"profile: {profile_detail['device_events']} device "
+              f"events ({profile_detail['device_events_dropped']} "
+              f"dropped past the cap) + "
+              f"{profile_detail['host_phase_events']} host-phase "
+              f"slices + {profile_detail['span_tracks']} spans -> "
+              f"{merged_path} (one timeline — load in "
+              f"https://ui.perfetto.dev)")
 
     emit("e2e_committed_ops_per_sec", round(n / dt, 1), "ops/s",
          detail=dict(
@@ -379,8 +484,10 @@ def main():
              p99_ms=(round(lat[int(nb * .99)] * 1e3, 2)
                      if nb else None),
              fence=bool(args.fence), audit=bool(args.audit),
+             telemetry=bool(args.telemetry),
              phases=phase_sums(),
              trace=trace_detail,
+             profile=profile_detail,
              health=health),
          obs=driver.obs, json_path=args.json)
 
@@ -426,6 +533,18 @@ def main():
                          audit=ab["audit"], e2e_audit=summary),
              obs=driver.obs, json_path=args.json)
 
+    if args.telemetry:
+        # e2e proof the counters flowed (the driver's own device_*
+        # series); the A/B overhead row runs AFTER driver.stop() —
+        # the live driver keeps dispatching its own telemetry-on idle
+        # steps from the poll loop, and that background host work
+        # biases the on-variant rounds by 10+ points on a small box
+        snap_counters = {
+            k: v for k, v in metrics_snap["counters"].items()
+            if k.startswith("device_")}
+        print(f"device telemetry: {len(snap_counters)} series "
+              f"exported during the workload")
+
     # replication check: every replica's app must converge to the same
     # key count (sharded: all G groups' committed streams replayed
     # into every replica's app)
@@ -452,6 +571,20 @@ def main():
     for a in apps:
         a.kill()
         a.wait()
+
+    if args.telemetry:
+        # counters on vs off, alternating best-of (the PR 5 audit
+        # methodology) — on the now-quiet process, so the row measures
+        # the counter vector, not poll-loop contention
+        ab = measure_telemetry_overhead()
+        print(f"telemetry overhead: {ab['off']['ops_per_sec']} ops/s "
+              f"off vs {ab['on']['ops_per_sec']} ops/s on "
+              f"({ab['overhead_pct']}% — target <5%)")
+        emit("telemetry_overhead_pct", ab["overhead_pct"], "%",
+             detail=dict(off=ab["off"], on=ab["on"],
+                         device_counters=ab["device_counters"],
+                         e2e_series=len(snap_counters)),
+             obs=driver.obs, json_path=args.json)
 
 
 if __name__ == "__main__":

@@ -33,12 +33,16 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 def run_one(G: int, *, replicas: int, steps: int, payload: int,
             burst: bool, json_path, cfg=None, mesh=None,
+            telemetry: bool = False,
             metric="shard_aggregate_committed_ops_per_sec",
             extra_detail=None):
     """Build, warm, and drive one G-group cluster; returns the result
     row dict (also emitted as a BENCH: line). ``mesh=(group_shards,
     replicas)`` runs the MULTI-CHIP engine — state sharded over a real
-    2-D ``(group, replica)`` device mesh instead of one device."""
+    2-D ``(group, replica)`` device mesh instead of one device.
+    ``telemetry=True`` compiles the device-counter step variants and
+    adds per-group (and, on a mesh, per-SHARD) committed-entry device
+    counters to the row — scaling provable from device truth alone."""
     from benchmarks.reporting import emit
     from rdma_paxos_tpu.config import LogConfig
     from rdma_paxos_tpu.obs import Observability
@@ -47,7 +51,8 @@ def run_one(G: int, *, replicas: int, steps: int, payload: int,
     if cfg is None:
         cfg = LogConfig(n_slots=2048, slot_bytes=128,
                         window_slots=256, batch_slots=256)
-    sc = ShardedCluster(cfg, replicas, G, mesh=mesh)
+    sc = ShardedCluster(cfg, replicas, G, mesh=mesh,
+                        telemetry=telemetry)
     sc.obs = Observability()
     targets = sc.place_leaders()
     B = cfg.batch_slots
@@ -100,6 +105,23 @@ def run_one(G: int, *, replicas: int, steps: int, payload: int,
         replay_fetch_dispatches=sc.fetch_dispatches - f0,
         compiled_programs_used=len(sc.programs_used),
     )
+    if telemetry:
+        # device-truth committed work: the ON-DEVICE commit-advance
+        # counter per group (max over the replica column — every
+        # replica of a group advances the same committed prefix), and
+        # its per-SHARD sums on a mesh (shard s owns the contiguous
+        # group block [s*G/gs, (s+1)*G/gs) under P(group) sharding) —
+        # the mesh scaling claim, provable without host bookkeeping
+        from rdma_paxos_tpu.obs import device as device_mod
+        col = device_mod.INDEX["committed_entries"]
+        per_g = [int(sc.device_counters[g, :, col].max())
+                 for g in range(G)]
+        detail["device_committed_per_group"] = per_g
+        if mesh is not None:
+            gs = sc.mesh.devices.shape[0]
+            blk = G // gs
+            detail["device_committed_entries"] = [
+                sum(per_g[s * blk:(s + 1) * blk]) for s in range(gs)]
     if extra_detail:
         detail.update(extra_detail)
     row = emit(metric, round(committed / dt, 1), "ops/s",
@@ -141,16 +163,19 @@ def run_mesh_sweep(layouts, *, groups_per_shard: int, steps: int,
                   f"have {n_dev})")
             continue
         if R not in baselines:
+            # telemetry ON for the baseline too: the A/B must compare
+            # identical programs (counter overhead on both sides)
             base = run_one(
                 groups_per_shard, replicas=R, steps=steps,
                 payload=payload, burst=burst, json_path=json_path,
+                telemetry=True,
                 metric="mesh_baseline_committed_ops_per_sec",
                 extra_detail=dict(role="single-chip baseline"))
             baselines[R] = base["value"]
         row = run_one(
             gs * groups_per_shard, replicas=R, steps=steps,
             payload=payload, burst=burst, json_path=json_path,
-            mesh=(gs, R),
+            mesh=(gs, R), telemetry=True,
             metric="mesh_aggregate_committed_ops_per_sec",
             extra_detail=dict(layout=f"{gs}x{R}", group_shards=gs,
                               devices=gs * R))
@@ -162,6 +187,8 @@ def run_mesh_sweep(layouts, *, groups_per_shard: int, steps: int,
                  aggregate_ops_per_sec=row["value"],
                  baseline_single_chip_ops_per_sec=baselines[R],
                  dispatch_per_step=row["detail"]["dispatch_per_step"],
+                 device_committed_entries=row["detail"].get(
+                     "device_committed_entries"),
                  driver=("burst" if burst else "step")),
              json_path=json_path)
         print(f"  {gs}x{R}: scaling efficiency {eff:.2f} "

@@ -76,6 +76,15 @@ from rdma_paxos_tpu.ops.quorum import R_PAD, commit_scan
 I32_MIN = jnp.iinfo(jnp.int32).min
 I32_MAX = jnp.iinfo(jnp.int32).max
 
+# telemetry counter-vector columns (``telemetry=True`` steps emit one
+# u32 vector per replica per step; the host-side consumer is
+# obs/device.py, which mirrors this layout — this module must NOT
+# import obs, so the two are pinned against each other by
+# tests/test_device_obs.py instead). Counters are per-step counts the
+# host accumulates; the last two columns are point-in-time gauges.
+(T_ELECTIONS, T_VOTES_GRANTED, T_VOTES_DENIED, T_ACCEPTED,
+ T_COMMITTED, T_UNHEARD, T_QUORUM_W, T_HEADROOM, T_N) = range(9)
+
 # control-gather columns (C_VTERM/C_VFOR carry each replica's durable vote
 # pair so vote records refresh on EVERY step — full or stable — not only
 # through the election-phase vote gather; C_QDEP carries each host's
@@ -153,6 +162,13 @@ class StepOutput:
     audit_start: Optional[jax.Array] = None    # i32 — first digested index
     audit_digest: Optional[jax.Array] = None   # [W] u32 — per-entry digests
     audit_term: Optional[jax.Array] = None     # [W] i32 — per-entry terms
+    # --- device telemetry (telemetry=True only) ---
+    # [T_N] u32 counter vector (see the T_* columns above): protocol
+    # counts as the DEVICE saw them, reduced in-program to scalars so
+    # readback is O(counters), never O(log). None in the default
+    # program — telemetry=False steps stay byte-identical
+    # (cache-key guarded by tests/test_device_obs.py).
+    telemetry: Optional[jax.Array] = None
 
 
 def make_step_input(cfg: LogConfig, n_replicas: int) -> StepInput:
@@ -197,6 +213,7 @@ def replica_step(
     fanout: str = "gather",
     elections: bool = True,
     audit: bool = False,
+    telemetry: bool = False,
 ) -> Tuple[ReplicaState, StepOutput]:
     """One protocol step for this replica (call under ``shard_map`` over the
     ``replica`` mesh axis, or under ``vmap(axis_name=...)`` for single-chip
@@ -234,6 +251,15 @@ def replica_step(
     the full step otherwise. Term adoption from the control gather and
     window absorption still run, so a deposed leader steps down and a
     higher-term leader is followed even in stable steps.
+
+    ``telemetry=True`` compiles the device-counter vector: one u32
+    ``[T_N]`` row per replica per step (elections started, votes
+    granted/denied, appends accepted, commit advance, unheard links,
+    quorum width, log headroom — the T_* columns above), built from
+    scalars already in registers and returned as the optional
+    ``StepOutput.telemetry`` field. The host consumer is
+    ``obs/device.py`` (never imported here); ``telemetry=False`` (the
+    default) is byte-identical to the pre-telemetry program.
 
     ``audit=True`` compiles the silent-divergence digest chain: one
     u32 checksum per committed entry in the window ``[commit - W,
@@ -756,6 +782,40 @@ def replica_step(
         audit_terms = jnp.where(
             a_valid, a_rows[:, cfg.slot_words + M_TERM].astype(i32), 0)
 
+    # ------------------------------------------------------------------
+    # Device telemetry (telemetry=True only; statically removed
+    # otherwise). Every value is a scalar already in registers — no
+    # log reads, no collectives — so the vector costs a handful of
+    # integer ops and its readback is O(T_N). Counter semantics are
+    # DEVICE truth: what this replica's program actually did this
+    # step, not what the host inferred (the gap this closes: unheard
+    # links count the link-model drops/partitions as consumed by the
+    # compiled step; quorum width is the ack count the commit scan
+    # really saw; headroom is the ring occupancy inside the dispatch).
+    # ------------------------------------------------------------------
+    telemetry_vec = None
+    if telemetry:
+        if elections:
+            t_elec = i_cand.astype(i32)
+            # granted = voted for ANOTHER replica's candidacy this
+            # step; denied = heard candidacies (own excluded) that did
+            # not get this replica's vote
+            t_grant = (vote_cast & (my_vote != me)).astype(i32)
+            n_cand = jnp.sum((is_cand & heard).astype(i32))
+            t_deny = jnp.maximum(n_cand - t_elec - t_grant, 0)
+        else:
+            t_elec = t_grant = t_deny = jnp.zeros((), i32)
+        telemetry_vec = jnp.stack([
+            t_elec,
+            t_grant,
+            t_deny,
+            (end2 - end1).astype(i32),
+            (commit2 - state.commit).astype(i32),
+            (R - jnp.sum(heard.astype(i32))).astype(i32),
+            jnp.sum((heard & (g_acks[:, 1] == me)).astype(i32)),
+            ((cfg.n_slots - 1) - (end3 - head2)).astype(i32),
+        ]).astype(jnp.uint32)
+
     new_state = ReplicaState(
         log=log3, term=new_term2, role=role2, leader_id=leader_id2,
         voted_term=new_voted_term, voted_for=new_voted_for,
@@ -809,6 +869,7 @@ def replica_step(
         audit_start=audit_start,
         audit_digest=audit_digest,
         audit_term=audit_terms,
+        telemetry=telemetry_vec,
     )
     return new_state, out
 
@@ -823,6 +884,7 @@ def group_step(
     fanout: str = "gather",
     elections: bool = True,
     audit: bool = False,
+    telemetry: bool = False,
 ):
     """The group-batched protocol step: G independent consensus groups
     advanced by ONE program.
@@ -855,7 +917,7 @@ def group_step(
         replica_step, cfg=cfg, n_replicas=n_replicas,
         axis_name=axis_name, use_pallas=use_pallas,
         interpret=interpret, fanout=fanout, elections=elections,
-        audit=audit)
+        audit=audit, telemetry=telemetry)
     vstep = jax.vmap(core, in_axes=(0, 0), axis_name=axis_name)
     return jax.vmap(vstep, in_axes=(0, 0))
 

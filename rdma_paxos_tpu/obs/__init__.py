@@ -27,7 +27,13 @@ Three parts, all host-side, all zero-dependency (stdlib only):
   first-divergence merge CLI.
 * :mod:`~rdma_paxos_tpu.obs.alerts` — declarative SLO alert rules
   (digest mismatch = page, leaderless, commit-latency p99, rebase
-  stalls) evaluated by the driver/daemon host loops.
+  stalls, election storms, low log headroom) evaluated by the
+  driver/daemon host loops.
+* :mod:`~rdma_paxos_tpu.obs.device` — device telemetry: the host
+  consumer of the on-device protocol-counter vector (``telemetry=True``
+  compiled steps), the bounded ``jax.profiler`` capture manager, the
+  merged span/host-phase/device Perfetto timeline, and per-variant
+  compiled-program cost reports.
 
 HARD RULE: no metrics/trace call may execute inside a
 jitted/``shard_map``ped function — instrumentation lives in the host
@@ -41,9 +47,10 @@ from __future__ import annotations
 from typing import Optional
 
 from rdma_paxos_tpu.obs import (
-    alerts, audit, clock, health, metrics, spans, trace)
+    alerts, audit, clock, device, health, metrics, spans, trace)
 from rdma_paxos_tpu.obs.alerts import AlertEngine
 from rdma_paxos_tpu.obs.audit import AuditLedger, FlightRecorder
+from rdma_paxos_tpu.obs.device import ProfilerSession
 from rdma_paxos_tpu.obs.health import HealthReporter
 from rdma_paxos_tpu.obs.metrics import MetricsRegistry
 from rdma_paxos_tpu.obs.spans import SpanRecorder, StepPhaseProfiler
@@ -98,5 +105,6 @@ def default() -> Observability:
 __all__ = ["Observability", "MetricsRegistry", "TraceRing",
            "HealthReporter", "SpanRecorder", "StepPhaseProfiler",
            "AuditLedger", "FlightRecorder", "AlertEngine",
+           "ProfilerSession",
            "default", "metrics", "trace", "health", "spans", "clock",
-           "audit", "alerts"]
+           "audit", "alerts", "device"]

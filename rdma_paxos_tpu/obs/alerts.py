@@ -57,11 +57,28 @@ _OPS = {
 
 
 def default_rules(*, commit_p99_ceiling_s: float = 0.5,
-                  leaderless_evals: int = 5) -> List[dict]:
+                  leaderless_evals: int = 5,
+                  election_storm_rate: int = 3,
+                  log_headroom_floor: int = 16) -> List[dict]:
     """The stock SLO rule set: digest mismatch pages immediately (a
     correctness violation, not a performance blip); sustained
     leaderlessness pages; commit-latency p99 above the ceiling and a
-    ticking rebase stall warn."""
+    ticking rebase stall warn.
+
+    Two rules read the DEVICE-telemetry series (``telemetry=True``
+    clusters — obs/device.py; without telemetry the series don't
+    exist, so the rules are silent):
+
+    * ``election_storm`` (``counter_rate``, page) — more than
+      ``election_storm_rate`` elections started ON DEVICE between two
+      evaluations, sustained for 2 evals: leadership is churning
+      faster than timers should ever fire (flapping links, a wedged
+      leader host, timeout skew).
+    * ``log_headroom_low`` (``gauge_cmp`` with ``agg="min"``, warn) —
+      some replica's ring reported fewer than ``log_headroom_floor``
+      free slots inside a dispatch: appends are about to stall on
+      ring capacity (pruning/apply is falling behind).
+    """
     return [
         dict(name="digest_divergence", severity=PAGE,
              kind="counter_nonzero", metric="audit_divergence_total"),
@@ -74,6 +91,12 @@ def default_rules(*, commit_p99_ceiling_s: float = 0.5,
              for_evals=2),
         dict(name="rebase_stalled", severity=WARN, kind="counter_rate",
              metric="rebase_stalled", threshold=0),
+        dict(name="election_storm", severity=PAGE, kind="counter_rate",
+             metric="device_elections_started_total",
+             threshold=election_storm_rate, for_evals=2),
+        dict(name="log_headroom_low", severity=WARN, kind="gauge_cmp",
+             metric="device_log_headroom", op="<",
+             value=log_headroom_floor, agg="min"),
     ]
 
 

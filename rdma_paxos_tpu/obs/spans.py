@@ -497,6 +497,17 @@ class StepPhaseProfiler:
         self.replica = replica
         self.acc: Dict[str, Tuple[int, float, float]] = {}
         self._open: Dict[str, int] = {}
+        # opt-in timestamped phase slices (enable_events): the
+        # host-phase TRACK of the merged device timeline
+        # (obs.device.merge_timeline) — histograms alone cannot place
+        # a phase on a wall-clock axis
+        self.events: Optional[collections.deque] = None
+
+    def enable_events(self, capacity: int = 65536) -> None:
+        """Record ``(phase, t0_monotonic, t1_monotonic)`` triples in a
+        bounded ring alongside the histograms (off by default — one
+        extra clock read per stop)."""
+        self.events = collections.deque(maxlen=capacity)
 
     def start(self, phase: str) -> None:
         self._open[phase] = time.perf_counter_ns()
@@ -508,6 +519,9 @@ class StepPhaseProfiler:
         us = (time.perf_counter_ns() - t0) / 1e3
         n, tot, mx = self.acc.get(phase, (0, 0.0, 0.0))
         self.acc[phase] = (n + 1, tot + us, max(mx, us))
+        if self.events is not None:
+            t1m = time.monotonic()
+            self.events.append((phase, t1m - us / 1e6, t1m))
         if self.metrics is not None:
             self.metrics.observe("step_phase_us", us,
                                  buckets=self.BUCKETS_US, phase=phase,
@@ -524,9 +538,20 @@ class StepPhaseProfiler:
         jax.block_until_ready(outputs)
         self.stop(PHASE_DEVICE_SYNC)
 
+    def sums(self) -> Dict[str, dict]:
+        """Per-phase ``{n, total_us, max_us}`` sums with zero-sample
+        phases SUPPRESSED — the one exporter benches embed in their
+        detail rows, so A/B tables never carry dead columns (e.g. a
+        ``device_sync`` row when ``fence=`` is off)."""
+        return {p: dict(n=a[0], total_us=round(a[1], 1),
+                        max_us=round(a[2], 1))
+                for p, a in sorted(self.acc.items()) if a[0] > 0}
+
     def report(self) -> str:
         lines = []
         for phase, (n, tot, mx) in sorted(self.acc.items()):
+            if n == 0:
+                continue          # zero-sample phases carry no signal
             lines.append(f"{phase}: n={n} mean={tot / max(n, 1):.1f}us "
                          f"max={mx:.1f}us")
         return "\n".join(lines)
@@ -563,13 +588,17 @@ def _critical_path(sp: dict, wall) -> List[Tuple[str, float, float]]:
             for (a, ta), (b, tb) in zip(chain, chain[1:])]
 
 
-def to_chrome_trace(dumps, *, max_cp_tracks: int = 512) -> dict:
+def to_chrome_trace(dumps, *, max_cp_tracks: int = 512,
+                    t0_wall: Optional[float] = None) -> dict:
     """Merge one or more span dumps into a Chrome trace-event JSON
     object (Perfetto-loadable): per-replica tracks carry instant
     phase marks correlated by ``(term, index)``; each sampled command
     additionally gets a critical-path track of duration slices.
     Dumps from different processes are aligned via their stamped
-    clock anchors."""
+    clock anchors. ``t0_wall`` overrides the computed timeline epoch —
+    the hook ``obs.device.merge_timeline`` uses to fold host-phase and
+    device-profiler tracks onto the SAME axis (and the only caller for
+    which the epoch lands in ``otherData``)."""
     if isinstance(dumps, dict):
         dumps = [dumps]
     walls: List[float] = []
@@ -583,7 +612,8 @@ def to_chrome_trace(dumps, *, max_cp_tracks: int = 512) -> dict:
         for sp in d["spans"]:
             walls.extend(wall(ts) for _, _, ts in sp["events"])
         prepared.append((d, wall))
-    t0 = min(walls) if walls else 0.0
+    t0 = (t0_wall if t0_wall is not None
+          else (min(walls) if walls else 0.0))
 
     def us(w):
         return round((w - t0) * 1e6, 3)
@@ -621,11 +651,15 @@ def to_chrome_trace(dumps, *, max_cp_tracks: int = 512) -> dict:
             for r in sorted(replicas_seen)]
     meta.append(dict(name="process_name", ph="M", pid=CP_PID, tid=0,
                      args=dict(name="critical path")))
+    other = dict(tool="rdma_paxos_tpu.obs.spans",
+                 dumps=len(prepared),
+                 spans=sum(len(d["spans"]) for d, _ in prepared))
+    if t0_wall is not None:
+        # only explicit-epoch callers carry it: the default export
+        # stays byte-identical (golden-file pinned)
+        other["t0_wall"] = t0
     return dict(traceEvents=meta + events, displayTimeUnit="ms",
-                otherData=dict(
-                    tool="rdma_paxos_tpu.obs.spans",
-                    dumps=len(prepared),
-                    spans=sum(len(d["spans"]) for d, _ in prepared)))
+                otherData=other)
 
 
 # ---------------------------------------------------------------------------

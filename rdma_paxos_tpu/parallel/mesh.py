@@ -116,7 +116,8 @@ def _unsqueeze(tree):
 def build_spmd_step(cfg: LogConfig, n_replicas: int, mesh: Mesh, *,
                     use_pallas: bool = False, interpret: bool = False,
                     donate: bool = True, fanout: str = "gather",
-                    elections: bool = True, audit: bool = False):
+                    elections: bool = True, audit: bool = False,
+                    telemetry: bool = False):
     """Compile the protocol step over a real device mesh.
 
     Takes/returns *batched* pytrees (leading ``replica`` axis, sharded one
@@ -128,7 +129,8 @@ def build_spmd_step(cfg: LogConfig, n_replicas: int, mesh: Mesh, *,
     core = functools.partial(
         replica_step, cfg=cfg, n_replicas=n_replicas,
         axis_name=REPLICA_AXIS, use_pallas=use_pallas, interpret=interpret,
-        fanout=fanout, elections=elections, audit=audit)
+        fanout=fanout, elections=elections, audit=audit,
+        telemetry=telemetry)
 
     def per_device(state_b, inp_b):
         st, out = core(_squeeze(state_b), _squeeze(inp_b))
@@ -144,7 +146,8 @@ def build_spmd_step(cfg: LogConfig, n_replicas: int, mesh: Mesh, *,
 def build_sim_burst(cfg: LogConfig, n_replicas: int, *,
                     use_pallas: bool = False, interpret: bool = False,
                     donate: bool = True, fanout: str = "gather",
-                    audit: bool = False):
+                    audit: bool = False,
+                    telemetry: bool = False):
     """K protocol steps fused into ONE dispatch (``lax.scan``) over the
     vmapped axis — the multi-step driver mode that amortizes host dispatch
     overhead when the submit queue is deep (the analog of the reference's
@@ -167,7 +170,8 @@ def build_sim_burst(cfg: LogConfig, n_replicas: int, *,
     core = functools.partial(
         replica_step, cfg=cfg, n_replicas=n_replicas,
         axis_name=REPLICA_AXIS, use_pallas=use_pallas, interpret=interpret,
-        fanout=fanout, elections=False, audit=audit)
+        fanout=fanout, elections=False, audit=audit,
+        telemetry=telemetry)
     vstep = jax.vmap(core, in_axes=(0, 0), axis_name=REPLICA_AXIS)
 
     def burst(state_b, datas, metas, counts, peer_mask, applied, qdepth):
@@ -199,7 +203,8 @@ def build_sim_burst(cfg: LogConfig, n_replicas: int, *,
 def build_spmd_burst(cfg: LogConfig, n_replicas: int, mesh: Mesh, *,
                      use_pallas: bool = False, interpret: bool = False,
                      donate: bool = True, fanout: str = "gather",
-                     audit: bool = False):
+                     audit: bool = False,
+                     telemetry: bool = False):
     """:func:`build_sim_burst` over a real device mesh (``shard_map`` with
     the K-step scan inside the per-device program)."""
     import jax.numpy as jnp
@@ -208,7 +213,8 @@ def build_spmd_burst(cfg: LogConfig, n_replicas: int, mesh: Mesh, *,
     core = functools.partial(
         replica_step, cfg=cfg, n_replicas=n_replicas,
         axis_name=REPLICA_AXIS, use_pallas=use_pallas, interpret=interpret,
-        fanout=fanout, elections=False, audit=audit)
+        fanout=fanout, elections=False, audit=audit,
+        telemetry=telemetry)
 
     def per_device(state_b, datas_b, metas_b, counts_b, peer_b,
                    applied_b, qdepth_b):
@@ -241,7 +247,8 @@ def build_spmd_burst(cfg: LogConfig, n_replicas: int, mesh: Mesh, *,
 def build_sim_group_step(cfg: LogConfig, n_replicas: int, *,
                          use_pallas: bool = False, interpret: bool = False,
                          donate: bool = True, fanout: str = "gather",
-                         elections: bool = True, audit: bool = False):
+                         elections: bool = True, audit: bool = False,
+                         telemetry: bool = False):
     """Compile the G-group × R-replica protocol step as ONE program on
     one device (:func:`rdma_paxos_tpu.consensus.step.group_step` under
     ``jit``). The group axis is an unnamed batch axis — groups are
@@ -251,7 +258,8 @@ def build_sim_group_step(cfg: LogConfig, n_replicas: int, *,
     mapped = group_step(cfg=cfg, n_replicas=n_replicas,
                         axis_name=REPLICA_AXIS, use_pallas=use_pallas,
                         interpret=interpret, fanout=fanout,
-                        elections=elections, audit=audit)
+                        elections=elections, audit=audit,
+                        telemetry=telemetry)
     return jax.jit(mapped, donate_argnums=(0,) if donate else ())
 
 
@@ -259,7 +267,8 @@ def build_sim_group_burst(cfg: LogConfig, n_replicas: int, *,
                           use_pallas: bool = False,
                           interpret: bool = False,
                           donate: bool = True, fanout: str = "gather",
-                          audit: bool = False):
+                          audit: bool = False,
+                          telemetry: bool = False):
     """:func:`build_sim_burst` with a leading ``group`` batch axis: K
     fused protocol steps over ALL G groups in ONE dispatch
     (``lax.scan`` of the group-batched stable step). Same contract as
@@ -275,7 +284,8 @@ def build_sim_group_burst(cfg: LogConfig, n_replicas: int, *,
     gstep = group_step(cfg=cfg, n_replicas=n_replicas,
                        axis_name=REPLICA_AXIS, use_pallas=use_pallas,
                        interpret=interpret, fanout=fanout,
-                       elections=False, audit=audit)
+                       elections=False, audit=audit,
+                       telemetry=telemetry)
 
     def burst(state_gb, datas, metas, counts, peer_mask, applied, qdepth):
         zeros_gr = jnp.zeros_like(counts[0])
@@ -295,7 +305,8 @@ def build_spmd_group_step(cfg: LogConfig, n_replicas: int, mesh: Mesh,
                           *, use_pallas: bool = False,
                           interpret: bool = False, donate: bool = True,
                           fanout: str = "gather",
-                          elections: bool = True, audit: bool = False):
+                          elections: bool = True, audit: bool = False,
+                          telemetry: bool = False):
     """:func:`build_sim_group_step` over a REAL 2-D ``(group,
     replica)`` device mesh (:func:`build_mesh_2d`): G groups × R
     replicas advanced by ONE ``shard_map``-compiled dispatch spanning
@@ -315,7 +326,8 @@ def build_spmd_group_step(cfg: LogConfig, n_replicas: int, mesh: Mesh,
         replica_step, cfg=cfg, n_replicas=n_replicas,
         axis_name=REPLICA_AXIS, use_pallas=use_pallas,
         interpret=interpret, fanout=fanout, elections=elections,
-        audit=audit)
+        audit=audit,
+        telemetry=telemetry)
     vcore = jax.vmap(core, in_axes=(0, 0))      # local groups, unnamed
 
     def per_device(state_b, inp_b):
@@ -337,7 +349,8 @@ def build_spmd_group_burst(cfg: LogConfig, n_replicas: int, mesh: Mesh,
                            *, use_pallas: bool = False,
                            interpret: bool = False,
                            donate: bool = True, fanout: str = "gather",
-                           audit: bool = False):
+                           audit: bool = False,
+                           telemetry: bool = False):
     """:func:`build_sim_group_burst` over the 2-D ``(group, replica)``
     mesh: K fused protocol steps × ALL G groups in ONE multi-chip
     dispatch (``lax.scan`` of the group-vmapped stable step inside the
@@ -353,7 +366,8 @@ def build_spmd_group_burst(cfg: LogConfig, n_replicas: int, mesh: Mesh,
         replica_step, cfg=cfg, n_replicas=n_replicas,
         axis_name=REPLICA_AXIS, use_pallas=use_pallas,
         interpret=interpret, fanout=fanout, elections=False,
-        audit=audit)
+        audit=audit,
+        telemetry=telemetry)
     vcore = jax.vmap(core, in_axes=(0, 0))      # local groups, unnamed
 
     def per_device(state_b, datas_b, metas_b, counts_b, peer_b,
@@ -390,12 +404,14 @@ def build_spmd_group_burst(cfg: LogConfig, n_replicas: int, mesh: Mesh,
 def build_sim_step(cfg: LogConfig, n_replicas: int, *,
                    use_pallas: bool = False, interpret: bool = False,
                    donate: bool = True, fanout: str = "gather",
-                   elections: bool = True, audit: bool = False):
+                   elections: bool = True, audit: bool = False,
+                   telemetry: bool = False):
     """Compile the protocol step as an N-replica simulation on one device
     (``vmap`` with a named axis — identical collective semantics)."""
     core = functools.partial(
         replica_step, cfg=cfg, n_replicas=n_replicas,
         axis_name=REPLICA_AXIS, use_pallas=use_pallas, interpret=interpret,
-        fanout=fanout, elections=elections, audit=audit)
+        fanout=fanout, elections=elections, audit=audit,
+        telemetry=telemetry)
     mapped = jax.vmap(core, in_axes=(0, 0), axis_name=REPLICA_AXIS)
     return jax.jit(mapped, donate_argnums=(0,) if donate else ())

@@ -111,7 +111,9 @@ class ClusterDriver:
                  health_period: float = 0.5, link_model=None,
                  fence: bool = False, audit: bool = False,
                  alert_rules: Optional[Sequence[dict]] = None,
-                 alert_period: float = 0.25, pipeline: int = 2):
+                 alert_period: float = 0.25, pipeline: int = 2,
+                 telemetry: bool = False,
+                 profile_on_page: float = 0.0):
         self.cfg = cfg
         self.sync_period = sync_period
         self._workdir = workdir
@@ -168,8 +170,13 @@ class ClusterDriver:
         # continuous proof that all R replicas hold bit-identical
         # committed state, with a bounded evidence ring dumped when
         # the digest-mismatch page fires
+        # telemetry=True compiles the device-counter step variants
+        # (obs/device.py): protocol counts as the DEVICE saw them,
+        # ingested on the readback thread into device_* series — the
+        # signals the telemetry-backed alert rules read
+        self._telemetry = telemetry
         self.cluster = self._make_cluster(cfg, n_replicas, group_size,
-                                          mode, fanout, audit)
+                                          mode, fanout, audit, telemetry)
         self.cluster.obs = self.obs
         self.cluster.profiler = self._phase_prof
         # SLO alert rules (obs/alerts.py) evaluated on a cadence from
@@ -183,6 +190,15 @@ class ClusterDriver:
         self._alert_period = alert_period
         self._alert_last = float("-inf")
         self.audit_artifact: Optional[str] = None
+        # bounded jax.profiler captures (obs/device.py:ProfilerSession):
+        # started via start_profile() (operator / bench CLI) or
+        # automatically on the first page-severity alert when
+        # profile_on_page > 0 (the capture duration in seconds); the
+        # observe pass enforces the bound so an alert-triggered capture
+        # can never run unbounded
+        self.profile_session = None
+        self._profile_on_page = float(profile_on_page)
+        self._page_profiled = False
         # chaos hook: a per-link fault model (chaos.faults.LinkModel)
         # driven from outside the poll loop — fault-injection drills
         # against a LIVE driver (apps + stores + poll thread), not just
@@ -269,11 +285,12 @@ class ClusterDriver:
         self._rb_thread: Optional[threading.Thread] = None
 
     def _make_cluster(self, cfg, n_replicas, group_size, mode, fanout,
-                      audit):
+                      audit, telemetry):
         """Engine factory (the sharded driver subclass overrides this
         to serve a multi-group ShardedCluster through the same loop)."""
         return SimCluster(cfg, n_replicas, group_size, mode=mode,
-                          fanout=fanout, audit=audit)
+                          fanout=fanout, audit=audit,
+                          telemetry=telemetry)
 
     # ------------------------------------------------------------------
     # shim event intake (called from proxy link threads)
@@ -647,6 +664,7 @@ class ClusterDriver:
         if now - self._alert_last >= self._alert_period:
             self._alert_last = now
             self.evaluate_alerts()
+        self._poll_profile()
         if self._health is not None and self._health.due():
             try:
                 self._health.write(self._health_snapshots(res))
@@ -691,14 +709,67 @@ class ClusterDriver:
         """One SLO-rule evaluation pass (also called on a cadence from
         the poll loop). A newly-firing ``page``-severity alert on an
         audited cluster dumps the audit artifact (ledger + flight ring
-        + obs dumps) for post-mortem."""
+        + obs dumps) for post-mortem, and — with ``profile_on_page``
+        set — starts ONE bounded device-profiler capture so the pages'
+        root cause is inspectable on the device timeline."""
         out = self.alerts.evaluate()
         pages = [n for n in out["fired"]
                  if self.alerts.severity(n) == "page"]
         if pages and (self.cluster.auditor is not None
                       or self.cluster.flight is not None):
             self._dump_audit_artifact("alert: " + ",".join(pages))
+        if (pages and self._profile_on_page > 0
+                and not self._page_profiled):
+            self._page_profiled = True      # one capture per process
+            try:
+                self.start_profile(seconds=self._profile_on_page)
+                self.obs.trace.record(obs_trace.ALERT_FIRED,
+                                      alert="profile_capture",
+                                      severity="info",
+                                      value=",".join(pages))
+            except RuntimeError:
+                pass        # another capture is active — keep serving
         return out
+
+    # ------------------------------------------------------------------
+    # bounded device-profiler captures (obs/device.py:ProfilerSession)
+    # ------------------------------------------------------------------
+
+    def start_profile(self, seconds: float = 5.0,
+                      log_dir: Optional[str] = None):
+        """Begin a bounded ``jax.profiler`` capture of the serving
+        path; the poll loop stops it when ``seconds`` elapse (or call
+        :meth:`stop_profile`). The capture's Chrome trace merges onto
+        the span timeline via ``obs.device.merge_timeline``."""
+        from rdma_paxos_tpu.obs.device import ProfilerSession
+        if self.profile_session is not None \
+                and self.profile_session.active:
+            raise RuntimeError("a profiler capture is already active")
+        if log_dir is None:
+            import tempfile
+            log_dir = (os.path.join(self._workdir, "profile")
+                       if self._workdir else
+                       tempfile.mkdtemp(prefix="rp_profile_"))
+        self.profile_session = ProfilerSession(
+            log_dir, max_seconds=seconds).start()
+        return self.profile_session
+
+    def stop_profile(self):
+        """Stop the active capture (idempotent); returns the session
+        (trace files resolved) or None when none was started."""
+        if self.profile_session is not None:
+            self.profile_session.stop()
+        return self.profile_session
+
+    def _poll_profile(self) -> None:
+        """Observe-pass hook: expire a bounded capture. Profiler I/O
+        must never kill the data path."""
+        s = self.profile_session
+        if s is not None and s.active:
+            try:
+                s.maybe_stop()
+            except Exception:  # noqa: BLE001 — evidence, not data path
+                pass    # stop() already marked the session inactive
 
     def _dump_audit_artifact(self, reason: str) -> Optional[str]:
         from rdma_paxos_tpu.obs.audit import write_audit_artifact

@@ -136,11 +136,11 @@ class ShardedClusterDriver(ClusterDriver):
         self._elect_round = [0] * self.G
 
     def _make_cluster(self, cfg, n_replicas, group_size, mode, fanout,
-                      audit):
+                      audit, telemetry):
         return ShardedCluster(cfg, n_replicas, self.G,
                               router=self._router, fanout=fanout,
                               group_size=group_size, audit=audit,
-                              mesh=self._mesh)
+                              mesh=self._mesh, telemetry=telemetry)
 
     def _span_rep(self, g: int, r: int) -> int:
         """Span-track replica id in the ENGINE's group namespace —
@@ -474,6 +474,7 @@ class ShardedClusterDriver(ClusterDriver):
         if now - self._alert_last >= self._alert_period:
             self._alert_last = now
             self.evaluate_alerts()
+        self._poll_profile()
         if self._health is not None and self._health.due():
             try:
                 self._health.write(self._health_snapshots(res))

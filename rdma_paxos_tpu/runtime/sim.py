@@ -234,7 +234,8 @@ class SimCluster:
                  use_pallas: Optional[bool] = None,
                  interpret: bool = False,
                  fanout: str = "gather", stable_fast_path: bool = True,
-                 audit: bool = False, flight_capacity: int = 64):
+                 audit: bool = False, flight_capacity: int = 64,
+                 telemetry: bool = False):
         self.cfg = cfg
         self.R = n_replicas
         self.group_size = group_size or n_replicas
@@ -253,6 +254,19 @@ class SimCluster:
         else:
             self.auditor = None
             self.flight = None
+        # device telemetry (obs/device.py): telemetry=True compiles the
+        # counter-vector step variants (distinct cache keys — default
+        # programs untouched, exactly the audit= discipline), reduces
+        # each dispatch's vectors host-side at finish() (the readback
+        # thread under the pipelined driver), accumulates them into
+        # ``device_counters`` [R, T_N], and exports device_* registry
+        # series when an obs facade is attached
+        self._telemetry = telemetry
+        if telemetry:
+            from rdma_paxos_tpu.obs import device as _device
+            self.device_counters = _device.zeros(n_replicas)
+        else:
+            self.device_counters = None
         # production default: the Pallas quorum kernel on TPU (same code
         # path as the benches), jnp reference scan elsewhere
         if use_pallas is None:
@@ -625,6 +639,19 @@ class SimCluster:
                 self._ingest_audit(res["audit_start"],
                                    res["audit_digest"],
                                    res["audit_term"], res["commit"])
+        if self._telemetry:
+            # device-truth counters: reduce the dispatch's per-step
+            # vectors (sum counters / min headroom over a fused burst),
+            # fold into the host accumulator, and export device_*
+            # registry series — all on THIS thread, which under the
+            # pipelined driver is the readback thread (finish runs
+            # there), so telemetry never rides the dispatch path
+            from rdma_paxos_tpu.obs import device as _device
+            tv = np.asarray(out.telemetry, dtype=np.int64)
+            res["telemetry"] = (_device.reduce_steps(tv) if burst
+                                else tv)
+            _device.accumulate(self.device_counters, res["telemetry"])
+            _device.ingest(self.obs, res["telemetry"])
         # ring-full backpressure / deposition: the appended set is a
         # PREFIX of ``taken`` — requeue the remainder in order
         # (submissions to non-leaders are dropped by design)
@@ -680,21 +707,17 @@ class SimCluster:
         # (tests/test_audit.py guards exactly this)
         key = (self.cfg, self.R, self._mode, self._use_pallas,
                self._interpret, self._fanout, "burst", K) \
-            + (("audit",) if self._audit else ())
+            + (("audit",) if self._audit else ()) \
+            + (("telemetry",) if self._telemetry else ())
         fn = self._STEP_CACHE.get(key)
         if fn is None:
+            kw = dict(use_pallas=self._use_pallas,
+                      interpret=self._interpret, fanout=self._fanout,
+                      audit=self._audit, telemetry=self._telemetry)
             if self._mode == "spmd":
-                fn = build_spmd_burst(self.cfg, self.R, self.mesh,
-                                      use_pallas=self._use_pallas,
-                                      interpret=self._interpret,
-                                      fanout=self._fanout,
-                                      audit=self._audit)
+                fn = build_spmd_burst(self.cfg, self.R, self.mesh, **kw)
             else:
-                fn = build_sim_burst(self.cfg, self.R,
-                                     use_pallas=self._use_pallas,
-                                     interpret=self._interpret,
-                                     fanout=self._fanout,
-                                     audit=self._audit)
+                fn = build_sim_burst(self.cfg, self.R, **kw)
             self._STEP_CACHE[key] = fn
         return fn
 
@@ -714,12 +737,14 @@ class SimCluster:
         variants, so they can never drift apart in build flags."""
         key = (self.cfg, self.R, self._mode, self._use_pallas,
                self._interpret, self._fanout, elections) \
-            + (("audit",) if self._audit else ())
+            + (("audit",) if self._audit else ()) \
+            + (("telemetry",) if self._telemetry else ())
         cached = self._STEP_CACHE.get(key)
         if cached is None:
             kw = dict(use_pallas=self._use_pallas,
                       interpret=self._interpret, fanout=self._fanout,
-                      elections=elections, audit=self._audit)
+                      elections=elections, audit=self._audit,
+                      telemetry=self._telemetry)
             if self._mode == "spmd":
                 cached = build_spmd_step(self.cfg, self.R, self.mesh, **kw)
             else:

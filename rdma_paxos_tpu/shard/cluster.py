@@ -108,7 +108,7 @@ class ShardedCluster:
                  stable_fast_path: bool = True,
                  group_size: Optional[int] = None,
                  audit: bool = False, flight_capacity: int = 64,
-                 mesh=None):
+                 mesh=None, telemetry: bool = False):
         if n_groups < 1:
             raise ValueError("n_groups must be >= 1")
         self.cfg = cfg
@@ -167,6 +167,19 @@ class ShardedCluster:
         else:
             self.auditor = None
             self.flight = None
+        # device telemetry (obs/device.py) — the SimCluster mechanism
+        # widened by the group axis: per-(group, replica) counter
+        # vectors reduced at finish() and exported as
+        # device_*{replica=,group=} series. On the mesh engine the
+        # out_specs gather brings every chip's vector back into the
+        # global [G, R, T_N] array, so per-shard counters survive the
+        # shard_map (tests pin mesh ≡ vmap telemetry parity).
+        self._telemetry = telemetry
+        if telemetry:
+            from rdma_paxos_tpu.obs import device as _device
+            self.device_counters = _device.zeros(self.G, self.R)
+        else:
+            self.device_counters = None
         self.state = stack_group_states(cfg, self.G, self.R,
                                         self.group_size)
         if mesh is not None:
@@ -328,12 +341,14 @@ class ShardedCluster:
         key = (self.cfg, self.R, self._mode, self._mesh_key,
                self._use_pallas, self._interpret, self._fanout,
                "group", elections) \
-            + (("audit",) if self._audit else ())
+            + (("audit",) if self._audit else ()) \
+            + (("telemetry",) if self._telemetry else ())
         cached = STEP_CACHE.get(key)
         if cached is None:
             kw = dict(use_pallas=self._use_pallas,
                       interpret=self._interpret, fanout=self._fanout,
-                      elections=elections, audit=self._audit)
+                      elections=elections, audit=self._audit,
+                      telemetry=self._telemetry)
             if self.mesh is not None:
                 cached = build_spmd_group_step(self.cfg, self.R,
                                                self.mesh, **kw)
@@ -346,12 +361,13 @@ class ShardedCluster:
         key = (self.cfg, self.R, self._mode, self._mesh_key,
                self._use_pallas, self._interpret, self._fanout,
                "group-burst", K) \
-            + (("audit",) if self._audit else ())
+            + (("audit",) if self._audit else ()) \
+            + (("telemetry",) if self._telemetry else ())
         fn = STEP_CACHE.get(key)
         if fn is None:
             kw = dict(use_pallas=self._use_pallas,
                       interpret=self._interpret, fanout=self._fanout,
-                      audit=self._audit)
+                      audit=self._audit, telemetry=self._telemetry)
             if self.mesh is not None:
                 fn = build_spmd_group_burst(self.cfg, self.R,
                                             self.mesh, **kw)
@@ -575,6 +591,18 @@ class ShardedCluster:
                 self._ingest_audit(res["audit_start"],
                                    res["audit_digest"],
                                    res["audit_term"], res["commit"])
+        if self._telemetry:
+            # per-(group, replica) device counters, reduced/accumulated
+            # exactly like SimCluster (finish runs on the readback
+            # thread under the pipelined driver); the mesh engine's
+            # out_specs gather already collected every chip's vector
+            # into the global [.., G, R, T_N] array
+            from rdma_paxos_tpu.obs import device as _device
+            tv = np.asarray(out.telemetry, dtype=np.int64)
+            res["telemetry"] = (_device.reduce_steps(tv) if burst
+                                else tv)
+            _device.accumulate(self.device_counters, res["telemetry"])
+            _device.ingest(self.obs, res["telemetry"])
         with self._host_lock:
             for g in range(G):
                 for r in range(R):
