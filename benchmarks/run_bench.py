@@ -30,7 +30,8 @@ NATIVE = os.path.join(os.path.dirname(os.path.dirname(
 
 def _measure_flag_overhead(flag, proof, cfg=None, *, n_replicas=3,
                            steps=300, per_step=8, payload=64,
-                           warmup=10, repeats=3):
+                           warmup=10, repeats=3, fanout="psum",
+                           make=None, after_step=None):
     """The shared compiled-step-flag A/B harness: drive the identical
     closed-loop workload through a flag-off and a flag-on
     ``SimCluster`` and compare committed-entry throughput. The two
@@ -39,7 +40,13 @@ def _measure_flag_overhead(flag, proof, cfg=None, *, n_replicas=3,
     easily exceeds the effect being measured). ``proof(on_cluster,
     out)`` attaches the flag-specific evidence the row carries.
     Returns ``{"off": {...}, "on": {...}, "overhead_pct": ...}`` (the
-    <5% acceptance target the overhead bench rows share)."""
+    <5% acceptance target the overhead bench rows share).
+
+    ``make(variant, cfg, n_replicas)`` overrides cluster construction
+    (for overheads that are not a bare SimCluster flag — e.g. the
+    repair controller) and ``after_step(variant, cluster)`` runs after
+    every step, both rounds identical except the measured delta —
+    the one methodology all overhead rows share."""
     import time as _t
 
     from rdma_paxos_tpu.config import LogConfig
@@ -51,12 +58,17 @@ def _measure_flag_overhead(flag, proof, cfg=None, *, n_replicas=3,
     blob = b"x" * payload
     clusters = {}
     for variant in ("off", "on"):
-        c = SimCluster(cfg, n_replicas, fanout="psum",
-                       **{flag: variant == "on"})
-        c.run_until_elected(0)
+        if make is not None:
+            c = make(variant, cfg, n_replicas)
+        else:
+            c = SimCluster(cfg, n_replicas, fanout=fanout,
+                           **{flag: variant == "on"})
+            c.run_until_elected(0)
         for _ in range(warmup):
             c.submit(0, blob)
             c.step()
+            if after_step is not None:
+                after_step(variant, c)
         clusters[variant] = c
     out = {v: dict(steps=steps, seconds=None, committed=None,
                    ops_per_sec=0.0) for v in clusters}
@@ -68,6 +80,8 @@ def _measure_flag_overhead(flag, proof, cfg=None, *, n_replicas=3,
                 for _ in range(per_step):
                     c.submit(0, blob)
                 c.step()
+                if after_step is not None:
+                    after_step(variant, c)
             dt = _t.perf_counter() - t0
             done = int(c.last["commit"].max()) + c.rebased_total - base
             ops = round(done / dt, 1)
@@ -101,6 +115,101 @@ def measure_telemetry_overhead(cfg=None, **kw):
                    on_c.device_counters[:, device_mod.INDEX[name]]]
             for name in device_mod.NAMES}
     return _measure_flag_overhead("telemetry", proof, cfg, **kw)
+
+
+def measure_repair(cfg=None, *, n_replicas=3, steps=300, per_step=8,
+                   payload=64, warmup=10, repeats=3,
+                   corrupt_after=40, probation=6, mttr_budget=400):
+    """The self-healing bench pair (``--repair``):
+
+    * ``repair_overhead_pct`` — identical closed-loop workload through
+      an audited cluster WITHOUT vs WITH a ``RepairController``
+      attached (clean run: the controller's per-step findings scan is
+      the overhead), ALTERNATING best-of rounds — the PR 5 audit A/B
+      methodology.
+    * ``mttr_steps`` — a scripted single-bit corruption of a
+      follower's committed slot, then the full
+      detect → quarantine → digest-verified re-install → backfill →
+      re-admit loop, measured in PROTOCOL STEPS from the corrupting
+      step to re-admission (step-domain: deterministic, host-load
+      independent).
+    """
+    from rdma_paxos_tpu.chaos.faults import corrupt_slot
+    from rdma_paxos_tpu.config import LogConfig
+    from rdma_paxos_tpu.runtime.repair import RepairController
+    from rdma_paxos_tpu.runtime.sim import SimCluster
+
+    if cfg is None:
+        cfg = LogConfig(n_slots=512, slot_bytes=128, window_slots=64,
+                        batch_slots=16)
+    blob = b"x" * payload
+    ctls = {}
+
+    # A/B rides the SHARED harness — only construction (controller
+    # attached; fanout="gather" because quarantine isolation is a
+    # peer-mask cut) and the per-step controller tick differ
+    def make(variant, mcfg, n_rep):
+        c = SimCluster(mcfg, n_rep, fanout="gather", audit=True)
+        c.run_until_elected(0)
+        if variant == "on":
+            ctls[variant] = RepairController(
+                c, probation_steps=probation)
+        return c
+
+    def after_step(variant, c):
+        ctl = ctls.get(variant)
+        if ctl is not None:
+            ctl.observe()
+            if ctl.needs_drain():
+                ctl.drive()
+
+    out = _measure_flag_overhead(
+        "repair", lambda on_c, o: None, cfg, n_replicas=n_replicas,
+        steps=steps, per_step=per_step, payload=payload,
+        warmup=warmup, repeats=repeats, make=make,
+        after_step=after_step)
+
+    # --- MTTR round: scripted corruption, loop until re-admitted ---
+    c = make("mttr", cfg, n_replicas)
+    ctl = RepairController(c, probation_steps=probation)
+    for _ in range(corrupt_after):
+        c.submit(0, blob)
+        c.step()
+        ctl.observe()
+    victim = 2
+    target = int(c.last["commit"].min()) - 1
+    corrupt_slot(c, victim, target)
+    corrupt_step = c.step_index
+    detected = quarantined = readmitted = None
+    for _ in range(mttr_budget):
+        c.submit(0, blob)
+        c.step()
+        ctl.observe()
+        if detected is None and c.auditor.findings:
+            detected = c.step_index
+        if quarantined is None and ctl.states:
+            quarantined = c.step_index
+        if ctl.needs_drain():
+            ctl.drive()
+        if quarantined is not None and not ctl.states:
+            readmitted = c.step_index
+            break
+    out["mttr"] = dict(
+        corrupt_step=corrupt_step, detected_step=detected,
+        quarantined_step=quarantined, readmitted_step=readmitted,
+        mttr_steps=(readmitted - corrupt_step
+                    if readmitted is not None else None),
+        detection_steps=(detected - corrupt_step
+                         if detected is not None else None),
+        repairs_done=ctl.repairs_done,
+        donors_rejected=ctl.donors_rejected,
+        backfilled=c.auditor.backfilled,
+        coverage_ok=(c.auditor.coverage(
+            0, c.auditor.repairs[0]["lo"],
+            c.auditor.repairs[0]["hi"])["ok"]
+            if c.auditor.repairs else False),
+        probation_steps=probation)
+    return out
 
 
 def client_worker(port, n, lat, tid, pipeline=1, retries=5):
@@ -197,6 +306,14 @@ def main():
                          "audit ledger + flight recorder + SLO alerts "
                          "during the workload, and emit an "
                          "audit-overhead A/B row (digests on vs off)")
+    ap.add_argument("--repair", action="store_true",
+                    help="self-healing bench: after the e2e run, A/B "
+                         "an audited cluster with vs without the "
+                         "RepairController attached "
+                         "(repair_overhead_pct, alternating best-of) "
+                         "and measure the full corruption→quarantine→"
+                         "verified-reinstall→backfill→re-admit loop "
+                         "in protocol steps (mttr_steps)")
     ap.add_argument("--telemetry", action="store_true",
                     help="device telemetry: compile the counter-vector "
                          "step variants (obs/device.py), export "
@@ -571,6 +688,26 @@ def main():
     for a in apps:
         a.kill()
         a.wait()
+
+    if args.repair:
+        # on the now-quiet process (same reasoning as --telemetry):
+        # the A/B measures the controller's findings scan, and the
+        # MTTR round measures the whole self-healing loop in
+        # step-domain time (deterministic, host-load independent)
+        ab = measure_repair()
+        mttr = ab["mttr"]
+        print(f"repair overhead: {ab['off']['ops_per_sec']} ops/s off "
+              f"vs {ab['on']['ops_per_sec']} ops/s on "
+              f"({ab['overhead_pct']}% — target <5%)")
+        print(f"MTTR: {mttr['mttr_steps']} steps corruption->re-admit "
+              f"(detect {mttr['detection_steps']}, probation "
+              f"{mttr['probation_steps']}), coverage_ok="
+              f"{mttr['coverage_ok']}")
+        emit("repair_overhead_pct", ab["overhead_pct"], "%",
+             detail=dict(off=ab["off"], on=ab["on"]),
+             obs=driver.obs, json_path=args.json)
+        emit("mttr_steps", mttr["mttr_steps"], "steps",
+             detail=mttr, obs=driver.obs, json_path=args.json)
 
     if args.telemetry:
         # counters on vs off, alternating best-of (the PR 5 audit

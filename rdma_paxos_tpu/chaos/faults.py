@@ -225,6 +225,27 @@ class HardStateTracker:
         return self._hs[r]
 
 
+def corrupt_slot(cluster, r: int, g_idx: int, *,
+                 group: Optional[int] = None, word: int = 0) -> None:
+    """Flip one payload bit of the slot holding global index ``g_idx``
+    in replica ``r``'s device log memory — the SILENT fault the audit
+    subsystem detects and the repair pipeline (``runtime/repair.py``)
+    heals. ``group`` targets one consensus group of a sharded
+    cluster. Pure state surgery (no link/timer effects); callers must
+    be on the drained serial path."""
+    import dataclasses as _dc
+
+    from rdma_paxos_tpu.consensus.log import Log as _Log
+
+    slot = int(g_idx) & (cluster.cfg.n_slots - 1)
+    buf = cluster.state.log.buf
+    if group is None:
+        buf = buf.at[int(r), slot, int(word)].add(1)
+    else:
+        buf = buf.at[int(group), int(r), slot, int(word)].add(1)
+    cluster.state = _dc.replace(cluster.state, log=_Log(buf=buf))
+
+
 def crash_replica(cluster, r: int, link: LinkModel) -> None:
     """Crash replica ``r``: it goes silent (the link model drops every
     message to and from it) until :func:`restart_replica`. Its device

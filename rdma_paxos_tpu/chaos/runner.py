@@ -210,7 +210,11 @@ class NemesisRunner:
                  artifact_path: Optional[str] = None,
                  skip_incompatible_faults: bool = False,
                  obs: Optional[Observability] = None,
-                 audit: bool = True, pipeline: int = 0):
+                 audit: bool = True, pipeline: int = 0,
+                 repair: bool = False,
+                 corrupt_step: Optional[int] = None,
+                 corrupt_offset: int = 1,
+                 repair_opts: Optional[dict] = None):
         self.cfg = cfg or DEFAULT_KV_CFG
         self.R = int(n_replicas)
         self.seed = int(seed)
@@ -253,6 +257,27 @@ class NemesisRunner:
         self.cluster = SimCluster(self.cfg, self.R, fanout=fanout,
                                   audit=audit)
         self.cluster.obs = self.obs
+        # self-healing mode (runtime/repair.py): a scripted bit
+        # corruption at ``corrupt_step`` (victim = leader +
+        # ``corrupt_offset``, target = the min committed index — both
+        # derived from protocol state, so same-seed runs corrupt the
+        # same slot) is detected by the audit, quarantined, repaired
+        # from a ledger-majority donor, backfilled, and re-admitted —
+        # and the verdict requires the loop to have CLOSED (zero
+        # unrepaired findings, no replica still held). The repair
+        # timeline (step-domain, deterministic) rides the verdict and
+        # any reproducer artifact.
+        self.repairer = None
+        if repair:
+            if not audit:
+                raise ValueError("repair=True requires audit=True")
+            from rdma_paxos_tpu.runtime.repair import RepairController
+            self.repairer = RepairController(self.cluster,
+                                             obs=self.obs,
+                                             **(repair_opts or {}))
+        self.corrupt_step = corrupt_step
+        self.corrupt_offset = int(corrupt_offset)
+        self.corrupted: Optional[tuple] = None   # (victim, index)
         self.link = LinkModel(self.R, seed=seed)
         self.link.obs = self.obs
         self.cluster.link_model = self.link
@@ -306,6 +331,8 @@ class NemesisRunner:
                                   **v.as_dict())
         leader = _leader_of(res)
         self.workload.observe(t, leader)
+        if self.repairer is not None:
+            self.repairer.observe()
         return leader
 
     def _finish_one(self, violations: List[dict]) -> int:
@@ -326,8 +353,26 @@ class NemesisRunner:
         would not cover them."""
         if self.pipeline < 2 or leader < 0:
             return False
+        if self._corrupt_due(t):
+            return False            # corruption is serial state surgery
+        if self.repairer is not None and self.repairer.needs_drain():
+            return False            # repairs drain in-flight tickets
         c = self.cluster
         return c.last is not None and not self.schedule.due(t)
+
+    def _corrupt_due(self, t: int) -> bool:
+        return (self.corrupt_step is not None
+                and self.corrupted is None
+                and t >= self.corrupt_step)
+
+    def _timer_excluded(self):
+        """Replicas whose election timers must not fire: crashed ones
+        and — under repair — quarantined/probation ones (an isolated
+        quarantined replica's futile candidacies would only inflate
+        its local term; a probation replica must not lead)."""
+        if self.repairer is None:
+            return self.link.down
+        return self.link.down | self.repairer.blocked_replicas(0)
 
     def _room_ok(self) -> bool:
         """Ring room for the WHOLE pending backlog (including entries
@@ -349,7 +394,7 @@ class NemesisRunner:
         self.history.set_clock(t)
         if self._pipeline_eligible(t, leader):
             self.workload.issue(t, leader, self.link.down)
-            timeouts = self.timers.fire(self.link.down)
+            timeouts = self.timers.fire(self._timer_excluded())
             if not timeouts and self._room_ok():
                 self._pl.append((t, self.cluster.begin_step()))
                 if len(self._pl) >= self.pipeline:
@@ -363,6 +408,20 @@ class NemesisRunner:
         # serial path: fault events mutate cluster/link state and must
         # never run under in-flight dispatches
         leader = self._drain(leader, violations)
+        if self._corrupt_due(t) and leader >= 0 \
+                and self.cluster.last is not None \
+                and int(self.cluster.last["commit"].min()) >= 1:
+            from rdma_paxos_tpu.chaos.faults import corrupt_slot
+            victim = (leader + self.corrupt_offset) % self.R
+            target = int(self.cluster.last["commit"].min()) - 1
+            corrupt_slot(self.cluster, victim, target)
+            self.corrupted = (victim, target)
+        if self.repairer is not None:
+            for (_g, rr) in self.repairer.drive():
+                # a snapshot re-install legitimately rewrites the
+                # repaired replica's offsets — same invariant-baseline
+                # reset as a crash restart
+                self.invariants.reset_replica(rr)
         fired = self.schedule.apply(t, self.cluster, self.link,
                                     timers=self.timers, hard=self.hard,
                                     kvs=self.kv)
@@ -370,7 +429,7 @@ class NemesisRunner:
             if ev["op"] == "restart":
                 self.invariants.reset_replica(ev["replica"])
         self.workload.issue(t, leader, self.link.down)
-        timeouts = self.timers.fire(self.link.down)
+        timeouts = self.timers.fire(self._timer_excluded())
         res = self.cluster.step(timeouts=timeouts)
         return self._observe_res(t, res, violations)
 
@@ -411,8 +470,18 @@ class NemesisRunner:
         linz = check_history(self.history.ops())
         audit_summary = (self.cluster.auditor.summary()
                          if self.cluster.auditor is not None else None)
-        audit_ok = (audit_summary is None
-                    or audit_summary["findings"] == 0)
+        repair_summary = (self.repairer.status()
+                          if self.repairer is not None else None)
+        if self.repairer is not None:
+            # self-healing acceptance: the loop must have CLOSED —
+            # every divergence repaired + backfilled, no replica still
+            # quarantined/on probation/escalated
+            audit_ok = (audit_summary is not None
+                        and audit_summary["unrepaired"] == 0
+                        and not repair_summary["active"])
+        else:
+            audit_ok = (audit_summary is None
+                        or audit_summary["findings"] == 0)
         ok = not violations and linz["ok"] is True and audit_ok
         verdict: Dict = dict(
             ok=ok, seed=self.seed, steps=self.steps,
@@ -424,6 +493,8 @@ class NemesisRunner:
                                  ops=linz["ops"],
                                  states=linz["states"]),
             audit=audit_summary,
+            repair=repair_summary,
+            corrupted=self.corrupted,
             history_events=len(self.history),
             client_ops=len(self.history.ops(include_weak=True)),
         )
@@ -456,6 +527,29 @@ class NemesisRunner:
                     "audit": (self.cluster.auditor.dump()
                               if self.cluster.auditor is not None
                               else None),
+                    "repair": repair_summary,
+                    "flight": (self.cluster.flight.dump()
+                               if self.cluster.flight is not None
+                               else None)})
+        elif (self.artifact_path and repair_summary is not None
+                and repair_summary["timeline"]):
+            # a HEALED run still ships its evidence when asked: the
+            # deterministic repair timeline + ledger (with the repair
+            # records closing the findings) — the self-healing loop's
+            # post-incident document
+            verdict["artifact"] = chaos_artifact.write_reproducer(
+                self.artifact_path, seed=self.seed,
+                schedule=self.schedule,
+                reason="divergence repaired (self-healed)",
+                config=self._config_doc(),
+                history=self.history.to_jsonl(),
+                violation=dict(invariants=[], linearizability={},
+                               audit=audit_summary),
+                obs=self.obs, extra={
+                    "verdict": {k: v for k, v in verdict.items()
+                                if k != "artifact"},
+                    "audit": self.cluster.auditor.dump(),
+                    "repair": repair_summary,
                     "flight": (self.cluster.flight.dump()
                                if self.cluster.flight is not None
                                else None)})

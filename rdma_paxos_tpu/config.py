@@ -38,6 +38,18 @@ MAX_BURST_K = 8
 # healthy min head catches up to an n_slots multiple.
 REBASE_STALL_STEPS = 25
 
+# Layout version of the audit digest fold (``consensus/step.py:
+# digest_fold`` — which columns are folded, in what order, with what
+# mixer). Digests from different layouts are INCOMPARABLE, not unequal:
+# the AuditLedger stamps this into every window/dump/snapshot and
+# refuses cross-epoch comparison with an ``EPOCH_MISMATCH`` finding
+# (never a false ``DIVERGENCE``), so the digest layout can be upgraded
+# one host at a time. Bump on ANY change to the fold. Defined here (not
+# in obs/ or consensus/) because both sides — the jitted producer and
+# the host-side ledger/snapshot consumers — must read the same value
+# without either importing the other.
+DIGEST_EPOCH = 1
+
 
 @dataclasses.dataclass(frozen=True)
 class LogConfig:

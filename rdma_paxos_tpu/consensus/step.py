@@ -185,6 +185,71 @@ def make_step_input(cfg: LogConfig, n_replicas: int) -> StepInput:
     )
 
 
+def digest_fold(rows, *, xp=jnp):
+    """The audit digest: one u32 mul-fold (FNV-1a accumulate + a
+    murmur3-style finalizer so a low-order flip diffuses) per fused
+    slot row, EXCLUDING the M_GIDX column (the coordinated i32
+    rollover rewrites gidx in place — position binding comes from the
+    ledger's absolute index instead; see the audit block in
+    :func:`replica_step`).
+
+    ONE implementation serves every digest producer — the ``audit=``
+    compiled step variant, the jitted range re-digest
+    (:func:`build_redigest`), and the host-side snapshot verification
+    in ``consensus/snapshot.py`` (``xp=numpy``) — so device and host
+    digests can never drift. The layout version is
+    ``config.DIGEST_EPOCH``; bump it whenever this fold changes.
+
+    ``rows``: ``[N, slot_words + META_W]`` u32 (jnp or numpy — both
+    wrap u32 arithmetic identically)."""
+    u32 = xp.uint32
+    prime = u32(0x01000193)                     # FNV-1a prime
+    acc = xp.full((rows.shape[0],), 0x811C9DC5, u32)   # FNV offset basis
+    gidx_col = rows.shape[1] - META_W + M_GIDX
+    for c in range(rows.shape[1]):
+        if c == gidx_col:
+            continue
+        acc = acc * prime + rows[:, c]
+    acc = acc ^ (acc >> 15)
+    acc = acc * u32(0x2C1B3C6D)
+    acc = acc ^ (acc >> 12)
+    acc = acc * u32(0x297A2D39)
+    acc = acc ^ (acc >> 15)
+    return acc
+
+
+def build_redigest(cfg: LogConfig, *, window_slots: int):
+    """Jitted ``[start, start + window_slots)`` digest pass over ONE
+    replica's fused log row — the backfill instrument of the repair
+    pipeline (``runtime/repair.py``): after a digest-verified snapshot
+    re-install, the donor's committed range is re-digested on device
+    and fed to the host-side audit ledger so the repaired range
+    returns to fully-audited (gap-free) coverage, not just healed
+    state.
+
+    Exactly the ``audit=`` window fold (:func:`digest_fold` — shared),
+    so backfilled digests are bit-comparable with live audit windows.
+    Returns ``(digests u32[W], terms i32[W], gidx i32[W])``; the host
+    validates the stamped gidx column against the expected indices
+    (slot-recycling integrity — same rule as the replay path) and
+    clips to the committed range.
+
+    CACHE-KEY GUARD: engines cache the compiled fn in the shared
+    ``STEP_CACHE`` under a distinct ``("redigest", W)``-marked key —
+    default / repair-off programs and their keys are untouched
+    (tests/test_repair.py pins it)."""
+    W = int(window_slots)
+    i32, u32 = jnp.int32, jnp.uint32
+    sw = cfg.slot_words
+
+    def fn(buf_row, start):
+        g = start + jnp.arange(W, dtype=i32)
+        rows = buf_row[slot_of(g, cfg.n_slots)]
+        dig = digest_fold(rows.astype(u32))
+        return dig, rows[:, sw + M_TERM].astype(i32), rows[:, sw + M_GIDX]
+    return jax.jit(fn)
+
+
 def _lex_argmax(valid: jax.Array, keys) -> jax.Array:
     """Index of the lexicographically-largest row among ``valid`` ones
     (ties → smallest index); -1 if none valid."""
@@ -765,20 +830,10 @@ def replica_step(
         audit_start = jnp.maximum(jnp.maximum(commit2 - W, head2), 0)
         a_valid = a_g >= audit_start
         a_rows = log3.buf[slot_of(a_g, cfg.n_slots)].astype(u32)
-        prime = u32(0x01000193)                   # FNV-1a prime
-        acc = jnp.full((W,), 0x811C9DC5, u32)     # FNV offset basis
-        gidx_col = cfg.slot_words + M_GIDX
-        for c in range(cfg.slot_words + META_W):
-            if c == gidx_col:
-                continue
-            acc = acc * prime + a_rows[:, c]
-        # murmur3-style finalizer so a low-order flip diffuses
-        acc = acc ^ (acc >> 15)
-        acc = acc * u32(0x2C1B3C6D)
-        acc = acc ^ (acc >> 12)
-        acc = acc * u32(0x297A2D39)
-        acc = acc ^ (acc >> 15)
-        audit_digest = jnp.where(a_valid, acc, u32(0))
+        # the fold lives in digest_fold — shared with the range
+        # re-digest program and the host-side snapshot verification,
+        # so no digest producer can drift from another
+        audit_digest = jnp.where(a_valid, digest_fold(a_rows), u32(0))
         audit_terms = jnp.where(
             a_valid, a_rows[:, cfg.slot_words + M_TERM].astype(i32), 0)
 

@@ -78,6 +78,13 @@ def default_rules(*, commit_p99_ceiling_s: float = 0.5,
       some replica's ring reported fewer than ``log_headroom_floor``
       free slots inside a dispatch: appends are about to stall on
       ring capacity (pruning/apply is falling behind).
+
+    ``repair_failed`` (``counter_nonzero``, page, LATCHED — the
+    counter never decrements) fires when the self-healing pipeline
+    (``runtime/repair.py``) exhausted its bounded donor retries for a
+    quarantined replica and escalated: automated repair gave up, an
+    operator must act. Silent on clusters that never escalate (the
+    metric does not exist until the first escalation).
     """
     return [
         dict(name="digest_divergence", severity=PAGE,
@@ -97,6 +104,8 @@ def default_rules(*, commit_p99_ceiling_s: float = 0.5,
         dict(name="log_headroom_low", severity=WARN, kind="gauge_cmp",
              metric="device_log_headroom", op="<",
              value=log_headroom_floor, agg="min"),
+        dict(name="repair_failed", severity=PAGE,
+             kind="counter_nonzero", metric="repair_escalated_total"),
     ]
 
 
@@ -188,6 +197,12 @@ class AlertEngine:
                     raise ValueError(
                         f"rule {r['name']!r}: bad op {r.get('op')!r}")
         self._lock = threading.Lock()
+        # alert→action hooks: fn(name, severity) called on each FIRE
+        # transition (outside the engine lock; exceptions are swallowed
+        # — an acting hook must never kill the evaluating poll loop).
+        # The repair pipeline registers here so a digest-divergence
+        # page triggers quarantine immediately.
+        self._hooks: List = []
         self._st: Dict[str, dict] = {
             r["name"]: dict(severity=r.get("severity", WARN),
                             firing=False, pending=0, value=None,
@@ -269,7 +284,18 @@ class AlertEngine:
                                   value=self._st[n]["value"])
             for n in resolved:
                 self.trace.record(_trace.ALERT_RESOLVED, alert=n)
+        for n in fired:
+            for hook in self._hooks:
+                try:
+                    hook(n, self._st[n]["severity"])
+                except Exception:  # noqa: BLE001 — hooks never kill
+                    pass           # the evaluating poll loop
         return dict(fired=fired, resolved=resolved)
+
+    def add_hook(self, fn) -> None:
+        """Register an alert→action hook ``fn(name, severity)`` —
+        invoked on every fire transition, after state/trace export."""
+        self._hooks.append(fn)
 
     # ---------------- state export ----------------
 

@@ -52,6 +52,13 @@ CRASH_RESTART = "crash_restart"          # chaos crash-restart recovery ran
 NEMESIS_VIOLATION = "nemesis_violation"  # chaos invariant/linearize failure
 AUDIT_DIVERGENCE = "audit_divergence"    # digest mismatch at (term, index)
 AUDIT_DUMPED = "audit_dumped"            # audit artifact written
+AUDIT_EPOCH_MISMATCH = "audit_epoch_mismatch"  # incomparable digest layout
+REPLICA_QUARANTINED = "replica_quarantined"  # diverged minority isolated
+REPAIR_DONOR_REJECTED = "repair_donor_rejected"  # donor failed digest verify
+REPAIR_INSTALLED = "repair_installed"    # digest-verified snapshot re-install
+REPAIR_BACKFILLED = "repair_backfilled"  # range re-digest restored coverage
+REPAIR_READMITTED = "repair_readmitted"  # probation passed; serving again
+REPAIR_ESCALATED = "repair_escalated"    # bounded retries exhausted (page)
 ALERT_FIRED = "alert_fired"              # SLO alert rule started firing
 ALERT_RESOLVED = "alert_resolved"        # SLO alert rule stopped firing
 

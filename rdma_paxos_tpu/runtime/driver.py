@@ -113,7 +113,9 @@ class ClusterDriver:
                  alert_rules: Optional[Sequence[dict]] = None,
                  alert_period: float = 0.25, pipeline: int = 2,
                  telemetry: bool = False,
-                 profile_on_page: float = 0.0):
+                 profile_on_page: float = 0.0,
+                 repair: bool = False,
+                 repair_opts: Optional[Dict] = None):
         self.cfg = cfg
         self.sync_period = sync_period
         self._workdir = workdir
@@ -190,6 +192,24 @@ class ClusterDriver:
         self._alert_period = alert_period
         self._alert_last = float("-inf")
         self.audit_artifact: Optional[str] = None
+        # self-healing (runtime/repair.py): repair=True closes the
+        # audit loop — DIVERGENCE → quarantine → digest-verified
+        # snapshot re-install from a ledger-majority donor →
+        # range-digest backfill → probation re-admit. observe() runs
+        # per finished step (readback thread); the state surgery runs
+        # only on drained serial iterations (_drain_admin →
+        # repair.drive; _pipeline_ready defers while a repair is due).
+        self.repair = None
+        if repair:
+            if not audit:
+                raise ValueError("repair=True requires audit=True "
+                                 "(the ledger drives donor selection "
+                                 "and install verification)")
+            from rdma_paxos_tpu.runtime.repair import RepairController
+            self.repair = RepairController(self.cluster, obs=self.obs,
+                                           **(repair_opts or {}))
+            self._wire_repair()
+            self.alerts.add_hook(self.repair.on_alert)
         # bounded jax.profiler captures (obs/device.py:ProfilerSession):
         # started via start_profile() (operator / bench CLI) or
         # automatically on the first page-severity alert when
@@ -292,6 +312,38 @@ class ClusterDriver:
                           fanout=fanout, audit=audit,
                           telemetry=telemetry)
 
+    def _wire_repair(self) -> None:
+        """Single-group driver: repair installs ride
+        :meth:`_do_recover` (store transfer + live-app delta replay
+        included) with the ledger passed through, so the install is
+        digest-verified end to end and a corrupted donor raises into
+        the controller's donor-retry loop."""
+        self.repair.install_hook = self._repair_install
+
+    def _repair_install(self, g: int, r: int, donor: int) -> None:
+        self._do_recover(r, donor, app_fresh=False,
+                         ledger=self.repair.led,
+                         min_verified=self.repair.min_verified)
+        # the device log + store are now healed from a digest-verified
+        # donor, but a LIVE interposed app may already have executed
+        # bytes the corruption reached before detection — its state
+        # cannot be trusted either way (the audit cannot tell pre- from
+        # post-replay corruption). Quarantine it through the existing
+        # mis-speculation machinery: the store keeps persisting, and
+        # the operator restarts the app + reset_app() rebuilds it from
+        # the healed store. Consensus-level re-admission (leadership,
+        # replication, audit coverage) completes automatically.
+        rt = self.runtimes[r]
+        if rt.replay is not None and not rt.app_dirty:
+            rt.app_dirty = True
+            rt.log.info_wtime(
+                "REPAIR: app quarantined pending reset_app (its state "
+                "may derive from corrupted committed bytes)")
+
+    def _repair_blocked(self, r: int, group: int = 0) -> bool:
+        return (self.repair is not None
+                and self.repair.serving_blocked(group, r))
+
     # ------------------------------------------------------------------
     # shim event intake (called from proxy link threads)
     # ------------------------------------------------------------------
@@ -383,10 +435,11 @@ class ClusterDriver:
     def _accepts_clients(self, r: int) -> bool:
         """Client-session admission: the single-group driver serves
         replicated sessions on the leader only (non-leaders give stale
-        local reads, the reference's follower semantics). The sharded
-        driver overrides this — every replica is a serving front-end
-        demuxing onto the G group leaders."""
-        return self._leader_view == r
+        local reads, the reference's follower semantics) — and never a
+        replica the repair pipeline holds in quarantine/probation. The
+        sharded driver overrides this — every replica is a serving
+        front-end demuxing onto the G group leaders."""
+        return self._leader_view == r and not self._repair_blocked(r)
 
     def _enqueue_locked(self, r: int, rt: _ReplicaRuntime, etype: int,
                         conn_id: int, payload: bytes):
@@ -453,6 +506,12 @@ class ClusterDriver:
                 box.append(exc)
             finally:
                 done.set()
+        # self-healing: due repairs run HERE — the serial path, after
+        # the dispatch loop drained every in-flight ticket (drive()
+        # itself defers if anything is still in flight, the same
+        # contract _drive_config_change uses)
+        if self.repair is not None:
+            self.repair.drive()
 
     def _pump_submitq(self) -> None:
         """Move intake rows into the engine's pending queues. Holds the
@@ -476,16 +535,22 @@ class ClusterDriver:
         # a flagged (force-pruned) leader never heals on its own: it
         # acks windows and heartbeats normally, so nothing deposes it,
         # its app/store stay frozen (stale reads), and every other
-        # flagged member's recovery starves behind it. Actively depose
-        # it: fire an election timeout on a healthy member each step
-        # until leadership moves (run_until_elected cadence).
+        # flagged member's recovery starves behind it. The same goes
+        # for a leader the repair pipeline holds (quarantine cuts its
+        # links, but it keeps self-claiming; probation must not lead
+        # either). Actively depose it: fire an election timeout on a
+        # healthy member each step until leadership moves
+        # (run_until_elected cadence).
         depose = -1
-        if (self._leader_view >= 0
-                and self._leader_view in self.cluster.need_recovery):
-            mask = self._mm.current(self._leader_view)["bitmask_new"]
+        lead = self._leader_view
+        if (lead >= 0
+                and (lead in self.cluster.need_recovery
+                     or self._repair_blocked(lead))):
+            mask = self._mm.current(lead)["bitmask_new"]
             healthy = [r for r in range(self.R)
-                       if (mask >> r) & 1 and r != self._leader_view
-                       and r not in self.cluster.need_recovery]
+                       if (mask >> r) & 1 and r != lead
+                       and r not in self.cluster.need_recovery
+                       and not self._repair_blocked(r)]
             if healthy:
                 depose = min(healthy)
 
@@ -584,10 +649,18 @@ class ClusterDriver:
         self._step_down_detector(res)
         self._failure_detector(res)
         self._drive_config_change()
+        # self-healing observation: consume new DIVERGENCE findings
+        # (quarantine is host bookkeeping — safe on this, the readback,
+        # thread) and advance probation hysteresis; the state surgery
+        # itself waits for a drained serial iteration (_drain_admin)
+        if self.repair is not None:
+            self.repair.observe()
         # a replica force-pruned past its apply cursor (wedged app now
         # unwedged, or long stall) stopped replaying; heal it with a
         # donor snapshot — the reference's straggler-eviction-then-
-        # rejoin collapsed into one step (one per iteration)
+        # rejoin collapsed into one step (one per iteration). Replicas
+        # the repair controller owns are ITS to heal (ledger-verified
+        # donor), not this default path's.
         if (self.cluster.need_recovery
                 and self._leader_view >= 0
                 # never under in-flight dispatches: snapshot install
@@ -604,7 +677,10 @@ class ClusterDriver:
             # flagged replica can still win elections — it acks windows
             # regardless of apply); it recovers once deposed, and must
             # not starve the others
-            cands = self.cluster.need_recovery - {self._leader_view}
+            owned = (self.repair.owned() if self.repair is not None
+                     else set())
+            cands = (self.cluster.need_recovery - {self._leader_view}
+                     - owned)
             if cands:
                 r = min(cands)
                 try:
@@ -809,6 +885,8 @@ class ClusterDriver:
                    if self.cluster.auditor is not None else None),
             alerts=self.alerts.state(),
             audit_artifact=self.audit_artifact,
+            repair=(self.repair.status()
+                    if self.repair is not None else None),
             ts=time.time(),
         )
 
@@ -1147,11 +1225,16 @@ class ClusterDriver:
         rt.log.info_wtime("APP RESET: rebuilt from committed store")
 
     def _do_recover(self, r: int, donor: Optional[int],
-                    app_fresh: bool = True) -> None:
+                    app_fresh: bool = True, ledger=None,
+                    min_verified: int = 1) -> None:
         """``app_fresh=False`` (the auto-recovery path) replays only the
         DELTA of the donor's history into r's still-running app — the
         app already executed its own store's prefix; a full replay would
-        double-apply non-idempotent commands."""
+        double-apply non-idempotent commands. ``ledger`` (the repair
+        pipeline) makes the transfer DIGEST-VERIFIED: the snapshot
+        carries the donor's audit-chain position and the install
+        refuses a donor contradicting the ledger majority — raising
+        BEFORE any state (device, store, or app) is touched."""
         donor = self._leader_view if donor is None else donor
         if donor < 0:
             raise RuntimeError("no donor available")
@@ -1160,7 +1243,9 @@ class ClusterDriver:
         # the blob matches the donor's HOST apply counter; the device
         # apply can lag it by one step's echo — snapshot at the host's
         snap = take_snapshot(self.cluster.state, donor, blob,
-                             index=int(self.cluster.applied[donor]))
+                             index=int(self.cluster.applied[donor]),
+                             digests=ledger is not None,
+                             rebased_total=self.cluster.rebased_total)
         # restore election durability: newest vote among live peers'
         # records (read BEFORE install wipes r's rows) and r's HardState
         # file; current term floored at all of them
@@ -1173,7 +1258,8 @@ class ClusterDriver:
                 vt, vf = hs[1], hs[2]
         self.cluster.state = install_snapshot(
             self.cluster.state, r, snap,
-            voted_term=vt, voted_for=vf, cur_term=cur_term)
+            voted_term=vt, voted_for=vf, cur_term=cur_term,
+            ledger=ledger, min_verified=min_verified)
         self.cluster.applied[r] = snap.index
         rt_stream = self.cluster.replayed[r]
         rrt.replay_cursor = len(rt_stream)
@@ -1364,6 +1450,11 @@ class ClusterDriver:
         # a membership change in flight polls device-side config state
         # every step — drive it through drained serial steps
         if self._config_phase is not None:
+            return False
+        # a due repair action needs the drained serial path (snapshot
+        # install + redigest are state surgery); pipelining re-engages
+        # the iteration after the repair completes
+        if self.repair is not None and self.repair.needs_drain():
             return False
         # stop dispatching once the i32-rollover threshold is crossed:
         # the rebase is deferred until the pipeline drains, and the

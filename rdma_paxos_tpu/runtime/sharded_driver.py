@@ -142,6 +142,44 @@ class ShardedClusterDriver(ClusterDriver):
                               group_size=group_size, audit=audit,
                               mesh=self._mesh, telemetry=telemetry)
 
+    def _wire_repair(self) -> None:
+        """Sharded driver: repair uses the controller's ENGINE-level
+        digest-verified install (per-group snapshot + backfill — one
+        group's repair never stalls the others); the driver only
+        resyncs its per-(replica, group) replay cursor. Store/app
+        rebuild for a repaired front-end rides ROADMAP item 4
+        (elastic resharding) — the repaired replica's consensus state
+        and audit coverage are fully restored here."""
+        self.repair.post_install = self._repair_post_install
+        self.repair.on_quarantine = self._repair_on_quarantine
+
+    def _repair_post_install(self, g: int, r: int, donor: int) -> None:
+        with self._lock:
+            self._replay_cursor[r][g] = len(self.cluster.replayed[g][r])
+
+    def _repair_on_quarantine(self, g: int, r: int) -> None:
+        """A front-end just entered quarantine for group ``g``: its
+        replay/apply stream for that group is frozen, so its blocked
+        commit waiters can never be ack-released — fail them now so
+        clients retry against a healthy front-end (invoked by the
+        controller OUTSIDE its lock)."""
+        releases = []
+        with self._lock:
+            dq = self._inflight_g[r][g]
+            n = len(dq)
+            while dq:
+                ev, _ = dq.popleft()
+                releases.append(ev)
+        for ev in releases:
+            ev.release(-1)
+        if releases:
+            self.obs.metrics.inc("inflight_failed_total", len(releases),
+                                 replica=r)
+            self.obs.trace.record(obs_trace.INFLIGHT_FAILED,
+                                  replica=r, group=g, count=len(releases),
+                                  site="repair quarantine")
+            self.obs.spans.fail_open(self._span_rep(g, r))
+
     def _span_rep(self, g: int, r: int) -> int:
         """Span-track replica id in the ENGINE's group namespace —
         delegated to the cluster so driver-side enqueue/ack/fail
@@ -163,8 +201,14 @@ class ShardedClusterDriver(ClusterDriver):
     # ------------------------------------------------------------------
 
     def _accepts_clients(self, r: int) -> bool:
-        # every replica fronts the cluster while any group is led; the
-        # per-group availability check happens at SEND routing time
+        # every replica fronts the cluster while any group is led (the
+        # per-group availability check happens at SEND routing time) —
+        # EXCEPT a replica the repair pipeline holds in any group: its
+        # replay for the held group is frozen, so sessions it admits
+        # could stall forever on ack release
+        if (self.repair is not None
+                and self.repair.serving_blocked_any(r)):
+            return False
         return any(v >= 0 for v in self._group_views)
 
     def _enqueue_locked(self, r: int, rt, etype: int, conn_id: int,
@@ -268,10 +312,22 @@ class ShardedClusterDriver(ClusterDriver):
                 # leaderless groups tick their step-domain timer once
                 # per poll iteration; a firing targets the rotation's
                 # next candidate (start at g % R — the round-robin
-                # spread place_leaders used to script explicitly)
+                # spread place_leaders used to script explicitly).
+                # Replicas the repair pipeline holds (quarantine /
+                # probation) are skipped — a quarantined candidate is
+                # cut from the hear-matrix and can never win anyway,
+                # and a probation replica must not lead while its
+                # clean-step hysteresis runs.
                 if self._gtimers[g].tick():
-                    cand = (g + self._elect_round[g]) % self.R
-                    self._elect_round[g] += 1
+                    cand = -1
+                    for _ in range(self.R):
+                        cc = (g + self._elect_round[g]) % self.R
+                        self._elect_round[g] += 1
+                        if not self._repair_blocked(cc, g):
+                            cand = cc
+                            break
+                    if cand < 0:
+                        continue        # every replica held — escalated
                     timeouts[g] = [cand]
                     self.obs.metrics.inc("election_timeouts_total",
                                          group=g)
@@ -295,6 +351,10 @@ class ShardedClusterDriver(ClusterDriver):
             return False
         if c.need_recovery:
             return False
+        # a due repair needs one drained serial iteration (per-group
+        # surgery); depth-D pipelining re-engages right after
+        if self.repair is not None and self.repair.needs_drain():
+            return False
         if int(c.last["end"].max()) >= self.cfg.rebase_threshold:
             return False
         # append batches only — see ClusterDriver._pipeline_ready
@@ -304,9 +364,14 @@ class ShardedClusterDriver(ClusterDriver):
     def _update_leader_view(self, res) -> None:
         views = []
         for g in range(self.G):
+            # a repair-held replica's self-claim is not a serving
+            # leadership: treating its group as leaderless fails the
+            # waiters (clients retry) and lets the group timer elect a
+            # healthy replacement instead of pinning the stale view
             claims = [(int(res["term"][g, r]), r)
                       for r in range(self.R)
-                      if int(res["role"][g, r]) == int(Role.LEADER)]
+                      if int(res["role"][g, r]) == int(Role.LEADER)
+                      and not self._repair_blocked(r, g)]
             views.append(max(claims)[1] if claims else -1)
         with self._lock:
             prev = self._group_views
@@ -376,6 +441,12 @@ class ShardedClusterDriver(ClusterDriver):
                 self._gtimers[g].beat()
         for r, rt in enumerate(self.runtimes):
             self._apply_new_entries(r, rt)
+        # self-healing observation (same contract as the base driver's
+        # _post_step): quarantine new findings / advance probation on
+        # every finished step — the surgery itself waits for a drained
+        # serial iteration (_drain_admin → repair.drive)
+        if self.repair is not None:
+            self.repair.observe()
         self._observe_step(res)
         return res
 
@@ -506,6 +577,8 @@ class ShardedClusterDriver(ClusterDriver):
                         else None),
             alerts=self.alerts.state(),
             audit_artifact=self.audit_artifact,
+            repair=(self.repair.status()
+                    if self.repair is not None else None),
             ts=time.time())
         return h
 

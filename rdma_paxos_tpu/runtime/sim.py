@@ -49,6 +49,69 @@ STEP_CACHE: Dict[tuple, object] = {}
 # carried-over refactor unlocking the mesh/e2e/resharding work).
 # ---------------------------------------------------------------------------
 
+def redigest_fn(cfg: LogConfig, window_slots: int):
+    """Fetch (or compile once into the shared cache) the jitted range
+    re-digest pass (``consensus/step.py:build_redigest``). The cache
+    key carries a distinct ``"redigest"`` marker, so repair-off
+    clusters' key sets (and programs) are untouched — the same
+    discipline as the ``audit=``/``telemetry=`` variants."""
+    key = (cfg, "redigest", int(window_slots))
+    fn = STEP_CACHE.get(key)
+    if fn is None:
+        from rdma_paxos_tpu.consensus.step import build_redigest
+        fn = build_redigest(cfg, window_slots=window_slots)
+        STEP_CACHE[key] = fn
+    return fn
+
+
+def run_redigest(cluster, buf_row, lo: int, hi: int, *, group: int,
+                 rebased_total: int, replica: int) -> int:
+    """Shared range re-digest rule for BOTH engines: digest the
+    committed entries ``[lo, hi)`` (raw offsets) of one replica's log
+    row through the jitted pass and feed them to the ledger as
+    BACKFILL windows (absolute indices, ``backfill=True`` — the
+    ledger's frontier self-check is not consulted for out-of-order
+    history re-reports). The stamped gidx column must equal the
+    expected index for every digested entry — a recycled slot means
+    the range is no longer physically present and backfilling it would
+    fabricate coverage. Returns the number of indices recorded.
+
+    Caller contract: dispatches drained (``require_drained`` — the
+    pass reads device log state an in-flight donated dispatch would
+    race) and ``lo >= head`` of that replica."""
+    require_drained(cluster._tickets, "redigest")
+    if cluster.auditor is None:
+        raise RuntimeError("redigest requires an audit=True cluster")
+    lo, hi = int(lo), int(hi)
+    if hi <= lo:
+        return 0
+    W = cluster._replay_W
+    fn = redigest_fn(cluster.cfg, W)
+    done = 0
+    start = lo
+    while start < hi:
+        with cluster._host_lock:
+            d_fut, t_fut, g_fut = fn(buf_row, jnp.int32(start))
+        dig = np.asarray(d_fut)
+        trm = np.asarray(t_fut)
+        gix = np.asarray(g_fut)
+        n = min(hi - start, W)
+        expect = np.arange(start, start + n, dtype=gix.dtype)
+        if not np.array_equal(gix[:n], expect):
+            bad = int(np.argmax(gix[:n] != expect))
+            raise RuntimeError(
+                "redigest integrity: slot of index %d holds gidx %d "
+                "(recycled past the range) — cannot backfill" %
+                (start + bad, int(gix[bad])))
+        cluster.auditor.record_window(
+            replica, start + rebased_total, dig[:n], trm[:n],
+            start + n + rebased_total, group=group, backfill=True,
+            step=cluster.step_index)
+        done += n
+        start += n
+    return done
+
+
 def require_drained(tickets, site: str) -> None:
     """Serial-path rule: a fused ``step()``/``step_burst()`` while
     dispatches are in flight would finish out of FIFO order AND mutate
@@ -788,6 +851,16 @@ class SimCluster:
     # ------------------------------------------------------------------
     # silent-divergence auditing (obs/audit.py; audit=True clusters)
     # ------------------------------------------------------------------
+
+    def redigest(self, replica: int, lo: int, hi: int) -> int:
+        """Range re-digest backfill: recompute the digest chain of
+        replica ``replica``'s committed entries ``[lo, hi)`` (raw
+        offsets) on device and feed it to the ledger — the repair
+        pipeline's coverage-restoration pass. Serial-path only (the
+        shared ``require_drained`` rule applies)."""
+        return run_redigest(self, self.state.log.buf[replica], lo, hi,
+                            group=0, rebased_total=self.rebased_total,
+                            replica=replica)
 
     def _ingest_audit(self, starts, digests, terms, commits) -> None:
         """Feed one step's per-replica digest windows to the ledger,

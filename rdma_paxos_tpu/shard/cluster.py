@@ -809,6 +809,21 @@ class ShardedCluster:
 
     # ---------------- observability ----------------
 
+    def redigest(self, group: int, replica: int, lo: int,
+                 hi: int) -> int:
+        """Range re-digest backfill for ONE group's replica (raw
+        offsets of that group) — the per-group form of
+        ``SimCluster.redigest``; other groups' state is untouched and
+        their dispatches resume as soon as this drained serial pass
+        returns. Shares the jitted redigest program (and its
+        ``"redigest"``-marked cache key) with the single-group
+        engine."""
+        from rdma_paxos_tpu.runtime.sim import run_redigest
+        return run_redigest(
+            self, self.state.log.buf[group, replica], lo, hi,
+            group=group, rebased_total=int(self.rebased_total[group]),
+            replica=replica)
+
     def _ingest_audit(self, starts, digests, terms, commits) -> None:
         """Per-group digest ingestion: ledger keys are ``(group,
         absolute index)`` with each group's own ``rebased_total``
