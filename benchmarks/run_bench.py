@@ -212,6 +212,149 @@ def measure_repair(cfg=None, *, n_replicas=3, steps=300, per_step=8,
     return out
 
 
+def measure_read_mix(read_ratio=0.9, cfg=None, *, n_replicas=3,
+                     n_ops=3000, n_keys=32, repeats=3, seed=11,
+                     payload=24):
+    """The read-scaling A/B (``--read-ratio``): drive the IDENTICAL
+    seeded read/write mix through two same-geometry clusters —
+
+    * ``lease``  — reads served host-side by the leaseholder
+      (``runtime/reads.py``): zero log traffic, batched local table
+      lookups (``get_many``), writes ride the ring as usual;
+    * ``log``    — the pre-lease baseline: every read rides the
+      replicated log as a stamped ``OP_GET`` entry (appended,
+      quorum-acked, committed, folded), competing with writes for
+      ring slots and committed-ops bandwidth.
+
+    Rounds ALTERNATE and each variant scores its fastest (the PR 5/6
+    best-of methodology). The proof carried by the row: the lease
+    variant's ``reads_served_total{path=lease}`` accounts for every
+    read it claims, and both variants completed the same op mix."""
+    import random as _random
+    import time as _t
+
+    from rdma_paxos_tpu.config import LogConfig
+    from rdma_paxos_tpu.models.replicated_kvs import ReplicatedKVS
+    from rdma_paxos_tpu.obs import Observability
+    from rdma_paxos_tpu.runtime import reads as reads_mod
+    from rdma_paxos_tpu.runtime.reads import count_read
+    from rdma_paxos_tpu.runtime.sim import SimCluster
+
+    if cfg is None:
+        cfg = LogConfig(n_slots=512, slot_bytes=128, window_slots=64,
+                        batch_slots=16)
+    keys = [b"rk%d" % i for i in range(n_keys)]
+    blob = b"x" * payload
+    B = cfg.batch_slots
+    CID = 5
+    setups = {}
+    for variant in ("log", "lease"):
+        c = SimCluster(cfg, n_replicas, fanout="psum")
+        c.obs = Observability()
+        if variant == "lease":
+            reads_mod.attach(c)
+        c.run_until_elected(0)
+        kv = ReplicatedKVS(c, cap=4096)
+        # seed the keyspace so every GET hits a live value
+        for i, k in enumerate(keys):
+            kv.put(0, k, b"seed", client_id=CID, req_id=i + 1)
+        while kv.last_req[0].get(CID, 0) < n_keys:
+            c.step()
+            kv._fold(0)
+        # compile the batched-GET tiers outside the timed rounds (a
+        # first-use JIT pause inside a round is not read cost)
+        for t in (16, 64, 256, 512):
+            kv.get_many(0, (keys * (t // n_keys + 1))[:t])
+        setups[variant] = dict(c=c, kv=kv,
+                               req=n_keys)   # stamped-req high water
+
+    def run_round(variant, rep):
+        c, kv = setups[variant]["c"], setups[variant]["kv"]
+        rng = _random.Random(f"readmix:{seed}:{rep}")
+        ops = [("r" if rng.random() < read_ratio else "w",
+                rng.randrange(n_keys)) for _ in range(n_ops)]
+        total_r = sum(1 for k, _ in ops if k == "r")
+        total_w = n_ops - total_r
+        req = setups[variant]["req"]
+        pend_w: set = set()
+        pend_r: dict = {}
+        lease_batch: list = []
+        reads_done = writes_done = 0
+        steps = 0
+        i = 0
+        t0 = _t.perf_counter()
+        while reads_done < total_r or writes_done < total_w:
+            budget = B
+            while i < len(ops) and budget > 0:
+                kind, ki = ops[i]
+                if kind == "w":
+                    req += 1
+                    kv.put(0, keys[ki], blob, client_id=CID,
+                           req_id=req)
+                    pend_w.add(req)
+                    budget -= 1
+                elif variant == "log":
+                    req += 1
+                    kv.submit_get(0, keys[ki], client_id=CID,
+                                  req_id=req)
+                    pend_r[req] = ki
+                    budget -= 1
+                else:
+                    lease_batch.append(keys[ki])    # host-side: free
+                i += 1
+            if lease_batch:
+                lm = c.leases
+                assert lm is not None and lm.valid(0, 0), \
+                    "leaseholder lost its lease mid-bench"
+                kv.get_many(0, lease_batch)
+                count_read(c.obs, "lease", 0, n=len(lease_batch))
+                reads_done += len(lease_batch)
+                lease_batch = []
+            if writes_done < total_w or (variant == "log"
+                                         and reads_done < total_r):
+                c.step()
+                steps += 1
+                kv._fold(0)
+                mark = kv.last_req[0].get(CID, 0)
+                done_w = [q for q in pend_w if q <= mark]
+                for q in done_w:
+                    pend_w.discard(q)
+                writes_done += len(done_w)
+                done_r = [q for q in pend_r if q <= mark]
+                if done_r:
+                    kv.get_many(0, [keys[pend_r.pop(q)]
+                                    for q in done_r])
+                    count_read(c.obs, "log", 0, n=len(done_r))
+                    reads_done += len(done_r)
+        dt = _t.perf_counter() - t0
+        setups[variant]["req"] = req
+        return dict(seconds=round(dt, 4), steps=steps,
+                    reads=reads_done, writes=writes_done,
+                    read_ops_per_sec=round(reads_done / dt, 1),
+                    write_ops_per_sec=round(writes_done / dt, 1),
+                    total_ops_per_sec=round(n_ops / dt, 1))
+
+    best = {v: None for v in setups}
+    for rep in range(repeats):
+        for variant in ("log", "lease"):
+            r = run_round(variant, rep)
+            if best[variant] is None or (r["read_ops_per_sec"]
+                                         > best[variant]
+                                         ["read_ops_per_sec"]):
+                best[variant] = r
+    from rdma_paxos_tpu.runtime.reads import read_counts
+    out = dict(read_ratio=read_ratio, n_ops=n_ops, repeats=repeats,
+               lease=best["lease"], log=best["log"],
+               lease_read_speedup=round(
+                   best["lease"]["read_ops_per_sec"]
+                   / max(best["log"]["read_ops_per_sec"], 1e-9), 2),
+               accounting=dict(
+                   lease_variant=read_counts(setups["lease"]["c"].obs),
+                   log_variant=read_counts(setups["log"]["c"].obs)),
+               leases=setups["lease"]["c"].leases.status())
+    return out
+
+
 def client_worker(port, n, lat, tid, pipeline=1, retries=5):
     """Pipelined client (the redis-benchmark -P analog): P commands per
     write — the app's read() picks them up as ONE buffer, so they ride a
@@ -314,6 +457,15 @@ def main():
                          "and measure the full corruption→quarantine→"
                          "verified-reinstall→backfill→re-admit loop "
                          "in protocol steps (mttr_steps)")
+    ap.add_argument("--read-ratio", type=float, default=0.0,
+                    help="read-mix workload: after the e2e run, A/B "
+                         "the read-scaling paths at this read "
+                         "fraction (e.g. 0.9 = 10:1 read-heavy) — "
+                         "leader-lease host-side serving vs the "
+                         "reads-through-log baseline on the same "
+                         "core; emits read_ops_per_sec / "
+                         "write_ops_per_sec / lease_read_speedup "
+                         "rows with path accounting")
     ap.add_argument("--telemetry", action="store_true",
                     help="device telemetry: compile the counter-vector "
                          "step variants (obs/device.py), export "
@@ -708,6 +860,32 @@ def main():
              obs=driver.obs, json_path=args.json)
         emit("mttr_steps", mttr["mttr_steps"], "steps",
              detail=mttr, obs=driver.obs, json_path=args.json)
+
+    if args.read_ratio > 0:
+        # on the now-quiet process (the --repair/--telemetry
+        # reasoning): the A/B measures the read paths, not poll-loop
+        # contention. The lease variant serves reads host-side from
+        # the leaseholder; the log variant rides every read through
+        # the replicated ring — what every linearizable read cost
+        # before PR 10.
+        rm = measure_read_mix(args.read_ratio)
+        acc = rm["accounting"]
+        print(f"read mix ({args.read_ratio:.0%} reads): "
+              f"{rm['lease']['read_ops_per_sec']:.0f} reads/s leased "
+              f"vs {rm['log']['read_ops_per_sec']:.0f} reads/s "
+              f"through-log -> {rm['lease_read_speedup']}x "
+              f"(lease-path accounting: "
+              f"{acc['lease_variant']['lease']} reads)")
+        emit("read_ops_per_sec", rm["lease"]["read_ops_per_sec"],
+             "ops/s", detail=dict(read_ratio=args.read_ratio,
+                                  variant="lease", **rm["lease"]),
+             obs=driver.obs, json_path=args.json)
+        emit("write_ops_per_sec", rm["lease"]["write_ops_per_sec"],
+             "ops/s", detail=dict(read_ratio=args.read_ratio,
+                                  variant="lease", **rm["lease"]),
+             obs=driver.obs, json_path=args.json)
+        emit("lease_read_speedup", rm["lease_read_speedup"], "x",
+             detail=rm, obs=driver.obs, json_path=args.json)
 
     if args.telemetry:
         # counters on vs off, alternating best-of (the PR 5 audit

@@ -33,7 +33,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 def run_one(G: int, *, replicas: int, steps: int, payload: int,
             burst: bool, json_path, cfg=None, mesh=None,
-            telemetry: bool = False,
+            telemetry: bool = False, read_ratio: float = 0.0,
             metric="shard_aggregate_committed_ops_per_sec",
             extra_detail=None):
     """Build, warm, and drive one G-group cluster; returns the result
@@ -57,6 +57,31 @@ def run_one(G: int, *, replicas: int, steps: int, payload: int,
     targets = sc.place_leaders()
     B = cfg.batch_slots
     blob = b"x" * payload
+    # read-mix column (read_ratio > 0): alongside every timed step's
+    # write feed, each group's LEASEHOLDER serves a host-side batch of
+    # lease reads sized read_ratio : (1-read_ratio) against the write
+    # load — the per-group read fan-out place_leaders + leases buy,
+    # visible per replica in the row
+    kvs = None
+    read_keys = None
+    reads_per_step = 0
+    if read_ratio > 0:
+        from rdma_paxos_tpu.runtime import reads as reads_mod
+        from rdma_paxos_tpu.shard.chaos import keys_for_groups
+        from rdma_paxos_tpu.shard.kvs import ShardedKVS
+        reads_mod.attach(sc)
+        kvs = ShardedKVS(sc, cap=4096)
+        read_keys = keys_for_groups(sc.router, 8, prefix=b"rmix")
+        for g in range(G):
+            for k in read_keys[g]:
+                kvs.groups[g].put(sc.leader_hint(g), k, b"seed")
+        sc.step()
+        sc.step()
+        # at least one read per group per step whenever the flag is
+        # set (int() would truncate small ratios to zero and silently
+        # disable the column), capped so extreme ratios stay feasible
+        reads_per_step = max(1, min(
+            int(B * read_ratio / max(1.0 - read_ratio, 1e-6)), 4 * B))
 
     def feed():
         for g in range(G):
@@ -77,6 +102,8 @@ def run_one(G: int, *, replicas: int, steps: int, payload: int,
                    + int(sc.rebased_total[g]) for g in range(G)]
     d0, f0 = sc.dispatches, sc.fetch_dispatches
     n_dispatch_steps = 0
+    reads_by_group = [0] * G
+    reads_by_replica = [0] * replicas
     t0 = time.perf_counter()
     for _ in range(steps):
         feed()
@@ -85,6 +112,20 @@ def run_one(G: int, *, replicas: int, steps: int, payload: int,
         else:
             sc.step()
         n_dispatch_steps += 1
+        if reads_per_step:
+            from rdma_paxos_tpu.runtime.reads import count_read
+            for g in range(G):
+                holder = sc.leases.serving_holder(g)
+                if holder < 0:
+                    continue
+                batch = (read_keys[g]
+                         * (reads_per_step // len(read_keys[g]) + 1)
+                         )[:reads_per_step]
+                kvs.groups[g].get_many(holder, batch)
+                count_read(sc.obs, "lease", holder, group=g,
+                           n=len(batch))
+                reads_by_group[g] += len(batch)
+                reads_by_replica[holder] += len(batch)
     dt = time.perf_counter() - t0
     per_group = [int(sc.last["commit"][g].max())
                  + int(sc.rebased_total[g]) - base_commit[g]
@@ -122,6 +163,24 @@ def run_one(G: int, *, replicas: int, steps: int, payload: int,
             blk = G // gs
             detail["device_committed_entries"] = [
                 sum(per_g[s * blk:(s + 1) * blk]) for s in range(gs)]
+    if reads_per_step:
+        # honest ratio reporting: reads_per_step is capped at 4*B, so
+        # at high requested ratios the EXECUTED mix can be leaner than
+        # asked — the row carries both, never just the request
+        total_reads = sum(reads_by_group)
+        detail["read_mix"] = dict(
+            requested_read_ratio=read_ratio,
+            effective_read_ratio=round(
+                total_reads / max(total_reads + committed, 1), 3),
+            reads_per_group_per_step=reads_per_step,
+            reads_total=total_reads,
+            read_ops_per_sec=round(total_reads / dt, 1),
+            reads_per_group=reads_by_group,
+            # the fan-out column: lease reads served per REPLICA —
+            # place_leaders spreads group leaseholds, so read serving
+            # spreads with them instead of piling onto one replica
+            reads_per_replica=reads_by_replica,
+            lease_holders=sc.leases.holders())
     if extra_detail:
         detail.update(extra_detail)
     row = emit(metric, round(committed / dt, 1), "ops/s",
@@ -137,7 +196,8 @@ def run_one(G: int, *, replicas: int, steps: int, payload: int,
 
 
 def run_mesh_sweep(layouts, *, groups_per_shard: int, steps: int,
-                   payload: int, burst: bool, json_path) -> int:
+                   payload: int, burst: bool, json_path,
+                   read_ratio: float = 0.0) -> int:
     """The multi-chip layout sweep: each ``GSxR`` layout runs G =
     GS * groups_per_shard groups over a real ``(group, replica)``
     device mesh of GS*R devices, A/B'd against a SINGLE-chip baseline
@@ -168,14 +228,14 @@ def run_mesh_sweep(layouts, *, groups_per_shard: int, steps: int,
             base = run_one(
                 groups_per_shard, replicas=R, steps=steps,
                 payload=payload, burst=burst, json_path=json_path,
-                telemetry=True,
+                telemetry=True, read_ratio=read_ratio,
                 metric="mesh_baseline_committed_ops_per_sec",
                 extra_detail=dict(role="single-chip baseline"))
             baselines[R] = base["value"]
         row = run_one(
             gs * groups_per_shard, replicas=R, steps=steps,
             payload=payload, burst=burst, json_path=json_path,
-            mesh=(gs, R), telemetry=True,
+            mesh=(gs, R), telemetry=True, read_ratio=read_ratio,
             metric="mesh_aggregate_committed_ops_per_sec",
             extra_detail=dict(layout=f"{gs}x{R}", group_shards=gs,
                               devices=gs * R))
@@ -231,6 +291,12 @@ def main(argv=None) -> int:
                          'baseline')
     ap.add_argument("--groups-per-shard", type=int, default=1,
                     help="groups per device row in --mesh mode")
+    ap.add_argument("--read-ratio", type=float, default=0.0,
+                    help="read-mix column: serve this read fraction "
+                         "as host-side lease reads at each group's "
+                         "leaseholder alongside the write feed — the "
+                         "per-group read fan-out shows up as "
+                         "reads_per_replica in every row")
     ap.add_argument("--json", default=None,
                     help="append JSON result rows to this file")
     args = ap.parse_args(argv)
@@ -267,7 +333,8 @@ def main(argv=None) -> int:
         return run_mesh_sweep(layouts,
                               groups_per_shard=args.groups_per_shard,
                               steps=args.steps, payload=args.payload,
-                              burst=args.burst, json_path=args.json)
+                              burst=args.burst, json_path=args.json,
+                              read_ratio=args.read_ratio)
 
     if args.groups is None:
         args.groups = "1,2,4,8"
@@ -281,7 +348,8 @@ def main(argv=None) -> int:
     for G in gs:
         row = run_one(G, replicas=args.replicas, steps=args.steps,
                       payload=args.payload, burst=args.burst,
-                      json_path=args.json)
+                      json_path=args.json,
+                      read_ratio=args.read_ratio)
         scaling[G] = row
     emit("shard_scaling",
          detail={str(G): dict(

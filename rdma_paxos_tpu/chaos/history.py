@@ -121,13 +121,27 @@ class HistoryRecorder:
         checker, in op_id order: each has ``op/key/value/out/inv/res/
         status``; ``res is None`` (timeout) means ambiguous. Weak reads
         are excluded by default — they are recorded evidence, not part
-        of the linearizable contract."""
+        of the linearizable contract.
+
+        TIMEOUT ops are exported with ``res=None`` — the checker's
+        ambiguity key — even though the raw event (and the internal
+        record) keeps the give-up clock as evidence. The recorder used
+        to leak that clock into ``res``, which silently made every
+        timed-out write a DEFINITE op bounded by the moment the client
+        gave up: stricter than the documented contract ("fate unknown
+        — may take effect at any later point, or never"), and a false
+        violation the moment a later read observed the pre-timeout
+        value after the give-up time (surfaced by the long-interval
+        read-index reads of the read-scaling chaos mix)."""
         out = []
         for i in sorted(self._ops):
             rec = self._ops[i]
             if rec["weak"] and not include_weak:
                 continue
-            out.append(dict(rec))
+            rec = dict(rec)
+            if rec["status"] == TIMEOUT:
+                rec["res"] = None
+            out.append(rec)
         return out
 
     def __len__(self) -> int:

@@ -83,10 +83,20 @@ class _Workload:
                  p_write: float = 0.45, p_rm: float = 0.12,
                  p_read: float = 0.5, p_weak: float = 0.3,
                  dup_msg_p: float = 0.15, dup_delay: int = 4,
-                 patience: int = 14):
+                 patience: int = 14, p_holder_read: float = 0.35,
+                 p_follower_read: float = 0.35,
+                 read_patience: int = 12):
         self.kv = kv
         self.h = history
         self.rng = random.Random(f"workload:{seed}")
+        # the read-path mix (leases + read-index follower reads,
+        # runtime/reads.py) draws from its OWN seeded rng so enabling
+        # it never perturbs the write/weak-read sequences existing
+        # seeds pin
+        self.rng_reads = random.Random(f"reads:{seed}")
+        self.p_holder_read = p_holder_read
+        self.p_follower_read = p_follower_read
+        self.read_patience = read_patience
         self.sessions = [kv.session(i + 1) for i in range(n_clients)]
         self.keys = [b"key%d" % i for i in range(n_keys)]
         self.outstanding: List[Optional[dict]] = [None] * n_clients
@@ -185,6 +195,47 @@ class _Workload:
             if live:
                 self.kv.get(self.rng.choice(live),
                             self.rng.choice(self.keys))
+        self._issue_reads(t, leader, down)
+
+    def _issue_reads(self, t: int, leader: int, down) -> None:
+        """The read-scaling mix (when the runner attached the read
+        path): a linearizable read AT THE LEASE HOLDER — even a
+        freshly deposed one, so chaos proves an expired/revoked lease
+        refuses rather than serves stale — and a READ-INDEX read
+        queued at a random live replica, drained by the hub at the
+        linearization point. All linearizable: the Wing–Gong checker
+        verdicts every one of them."""
+        hub = getattr(self.kv.c, "reads", None)
+        if hub is None:
+            return
+        rr = self.rng_reads
+        lm = self.kv.c.leases
+        if rr.random() < self.p_holder_read:
+            holder = (lm.serving_holder(0) if lm is not None else -1)
+            target = holder if holder >= 0 else leader
+            if target >= 0 and target not in down:
+                # a crashed process serves nothing; a PARTITIONED
+                # holder is the interesting case and stays eligible
+                self.kv.get(target, rr.choice(self.keys),
+                            linearizable=True)
+        if rr.random() < self.p_follower_read:
+            live = [r for r in range(self.kv.c.R) if r not in down]
+            if live:
+                f = rr.choice(live)
+                key = rr.choice(self.keys)
+                op_id = self.h.invoke("get", key, replica=f)
+
+                def done(status, value, _op=op_id):
+                    if status == "ok":
+                        self.h.ok(_op, value)
+                    else:
+                        # never served: definitively did not happen
+                        self.h.fail(_op, reason="read_unserved")
+
+                hub.submit(
+                    lambda f=f, k=key: self.kv.serve_local(f, k),
+                    replica=f, patience=self.read_patience,
+                    step0=t, on_done=done)
 
     def finish(self) -> None:
         """Run end: every still-unresolved op is ambiguous."""
@@ -211,6 +262,7 @@ class NemesisRunner:
                  skip_incompatible_faults: bool = False,
                  obs: Optional[Observability] = None,
                  audit: bool = True, pipeline: int = 0,
+                 leases: bool = True,
                  repair: bool = False,
                  corrupt_step: Optional[int] = None,
                  corrupt_offset: int = 1,
@@ -278,6 +330,15 @@ class NemesisRunner:
         self.corrupt_step = corrupt_step
         self.corrupt_offset = int(corrupt_offset)
         self.corrupted: Optional[tuple] = None   # (victim, index)
+        # read path (runtime/reads.py): chaos runs exercise leader
+        # leases + read-index follower reads BY DEFAULT — every
+        # linearizable read lands in the checked history, so a lease
+        # serving stale state under the schedule is a caught
+        # violation, and the lease timeline (grant/renew/expire/
+        # revoke) rides the trace ring into any reproducer artifact
+        if leases:
+            from rdma_paxos_tpu.runtime import reads as reads_mod
+            reads_mod.attach(self.cluster)
         self.link = LinkModel(self.R, seed=seed)
         self.link.obs = self.obs
         self.cluster.link_model = self.link
@@ -461,6 +522,10 @@ class NemesisRunner:
                 if violations:
                     break
             leader = self._drain(leader, violations)
+        if self.cluster.reads is not None:
+            # still-queued reads will never be confirmed: fail them
+            # (their history records close as FAIL — constraint-free)
+            self.cluster.reads.fail_all("run end")
         self.workload.finish()
         if not violations:
             try:
@@ -498,6 +563,14 @@ class NemesisRunner:
             history_events=len(self.history),
             client_ops=len(self.history.ops(include_weak=True)),
         )
+        if self.cluster.reads is not None:
+            # deterministic read-path summary: per-path served totals
+            # (registry accounting), hub state, lease timeline counts
+            from rdma_paxos_tpu.runtime.reads import read_counts
+            verdict["reads"] = dict(
+                read_counts(self.obs),
+                hub=self.cluster.reads.status(),
+                leases=self.cluster.leases.status())
         if not ok:
             # ok=None (state budget exceeded) is NOT a found violation —
             # label it honestly so nobody chases a bug that was never

@@ -16,6 +16,7 @@ the same trade the reference's follower apps offer.
 
 from __future__ import annotations
 
+import time
 from typing import List, Optional
 
 import jax
@@ -49,6 +50,8 @@ class ReplicatedKVS:
                                       for _ in range(cluster.R)]
         self._cursor = [0] * cluster.R
         self._apply_jit = jax.jit(apply_cmd)
+        self._get_many_jit = None      # compiled lazily on first batch
+        self._get_cmds: dict = {}      # GET-encoding cache (hot keys)
         # per-replica endpoint registry: client_id -> highest applied
         # req_id (the dare_ep_db ``last_req_id`` analog,
         # dare_ep_db.h:20-30). Folded DETERMINISTICALLY from the
@@ -142,15 +145,56 @@ class ReplicatedKVS:
 
     def get(self, r: int, key: bytes, *,
             linearizable: bool = False) -> Optional[bytes]:
-        """Read from replica ``r``'s table. With ``linearizable=True`` the
-        read is refused (returns None) unless ``r`` verified leadership on
-        the latest step — the read-index rule."""
+        """Read from replica ``r``'s table. A ``linearizable=True``
+        read serves through one of two zero-log-traffic paths:
+
+        * **lease** — ``r`` holds a valid step-domain leader lease
+          (``cluster.leases`` attached via ``runtime/reads.py``): no
+          per-read verification round at all, the renewal rides the
+          heartbeat/quorum machinery the protocol already runs;
+        * **read_index** — ``r`` verified leadership on the latest
+          finished step (the pre-lease rule, and the fallback a new
+          leader uses while it waits out the old lease).
+
+        Refused (returns None, recorded as a FAIL — the read
+        definitively did not happen) when neither holds."""
+        t0 = time.monotonic() if linearizable else None
         op_id = (self.history.invoke("get", key, replica=r,
                                      weak=not linearizable)
                  if self.history is not None else None)
+        path = None
         if linearizable:
+            # a quarantined/recovering replica must not serve at all —
+            # not even through a stale leadership_verified snapshot
+            # from the step before its links were cut (the repair
+            # pipeline revokes its lease; this closes the one-step
+            # read-index window too). read_blocked covers the repair
+            # holds need_recovery does not: the storm policy leaves
+            # replay running, and the digest path drops need_recovery
+            # at install time while probation still bars serving.
+            if (r in getattr(self.c, "need_recovery", ())
+                    or r in getattr(self.c, "read_blocked", ())):
+                if op_id is not None:
+                    self.history.fail(op_id, reason="quarantined")
+                return None
+            lm = getattr(self.c, "leases", None)
+            g = self.group if self.group is not None else 0
             last = self.c.last
-            if last is None or not last["leadership_verified"][r]:
+            # the serving frontier gate the hub also enforces: the
+            # local apply cursor must cover the replica's own commit
+            # index, else state already ACKED to writers is missing
+            # from the table (a wedged apply keeps acking windows, so
+            # leadership_verified — and the lease — stay live while
+            # applied freezes below commit)
+            applied = getattr(self.c, "applied", None)
+            caught_up = (last is not None and applied is not None
+                         and int(applied[r])
+                         >= int(last["commit"][r]))
+            if caught_up and lm is not None and lm.valid(g, r):
+                path = "lease"
+            elif caught_up and last["leadership_verified"][r]:
+                path = "read_index"
+            else:
                 # a REFUSED read definitively did not happen — fail,
                 # not timeout (the checker drops it, constraint-free)
                 if op_id is not None:
@@ -162,9 +206,77 @@ class ReplicatedKVS:
                                  jnp.asarray(encode_cmd(OP_GET, key)))
         v = decode_val(np.asarray(out))
         v = v if v else None
+        if path is not None:
+            from rdma_paxos_tpu.runtime.reads import count_read
+            count_read(getattr(self.c, "obs", None), path, r,
+                       group=self.group, t0=t0)
         if op_id is not None:
             self.history.ok(op_id, v)
         return v
+
+    def serve_local(self, r: int, key: bytes) -> Optional[bytes]:
+        """Bare local table read (fold + lookup) with NO linearization
+        gate and NO accounting — the serve callback for hub-queued
+        reads, whose linearization point (lease validity or confirmed
+        read index + apply frontier) the :class:`ReadHub` establishes
+        before invoking it."""
+        self._fold(r)
+        _, out = self._apply_jit(self.tables[r],
+                                 jnp.asarray(encode_cmd(OP_GET, key)))
+        v = decode_val(np.asarray(out))
+        return v if v else None
+
+    # batched local GETs: one vmapped dispatch per power-of-two tier
+    # instead of a per-key apply dispatch — how a leaseholder (or a
+    # read-index follower) serves a read BURST cheaply
+    _GET_TIERS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512)
+
+    def get_many(self, r: int, keys) -> List[Optional[bytes]]:
+        """Serve a batch of local reads from replica ``r``'s table in
+        ONE vmapped device dispatch (padded to a power-of-two tier so
+        compiles stay bounded). Linearization gating and accounting
+        are the CALLER's job — this is the serving primitive the
+        lease/read-index paths and the read-mix bench share."""
+        if not keys:
+            return []
+        self._fold(r)
+        if self._get_many_jit is None:
+            self._get_many_jit = jax.jit(jax.vmap(
+                lambda kv, cmd: apply_cmd(kv, cmd)[1],
+                in_axes=(None, 0)))
+        out: List[Optional[bytes]] = []
+        i = 0
+        while i < len(keys):
+            chunk = keys[i:i + self._GET_TIERS[-1]]
+            tier = next(t for t in self._GET_TIERS
+                        if t >= len(chunk))
+            cmds = np.zeros((tier, CMD_W), "<i4")
+            for j, k in enumerate(chunk):
+                # hot read sets repeat keys: cache their encodings
+                row = self._get_cmds.get(k)
+                if row is None:
+                    row = encode_cmd(OP_GET, k)
+                    if len(self._get_cmds) < 65536:
+                        self._get_cmds[k] = row
+                cmds[j] = row
+            vals = np.asarray(self._get_many_jit(
+                self.tables[r], jnp.asarray(cmds)))
+            for j in range(len(chunk)):
+                v = decode_val(vals[j])
+                out.append(v if v else None)
+            i += len(chunk)
+        return out
+
+    def submit_get(self, leader: int, key: bytes, *, client_id: int,
+                   req_id: int) -> None:
+        """The READS-THROUGH-LOG baseline: ride a stamped ``OP_GET``
+        entry through the replicated log like a write — appended,
+        quorum-acked, committed, folded (the dedup registry marks its
+        ``req_id``, so completion is observable via ``last_req``).
+        This is what every linearizable read cost before leases; the
+        read-mix bench A/Bs the lease path against it."""
+        self.c.submit(leader, encode_cmd(OP_GET, key).tobytes(),
+                      conn=client_id, req_id=req_id)
 
 
 class ClientSession:

@@ -165,6 +165,13 @@ class SpanRecorder:
         # per-origin-replica ack matching: (req, key) — the driver
         # releases acks by monotone submit sequence
         self._await_ack: Dict[int, list] = {}
+        # cheap read-span variant (runtime/reads.py): completed
+        # lease/read-index reads as (replica, path, t0, t1) records —
+        # no correlation machinery, own sampling counter so read
+        # traffic can never shift which COMMANDS get sampled
+        self._reads: collections.deque = collections.deque(
+            maxlen=capacity)
+        self._read_counter = 0
 
     # ---------------- cheap-path predicates ----------------
 
@@ -400,6 +407,25 @@ class SpanRecorder:
 
     # ---------------- queries / export ----------------
 
+    def read_span(self, replica: int, path: str, t0: float, *,
+                  group: int = -1, status: str = DONE) -> bool:
+        """Record one served linearizable READ as a lightweight span
+        (sampled like commands, but on a separate counter): the read
+        critical path is just [enqueue, serve] on the serving replica
+        — no append/commit/apply correlation to carry. Rendered as
+        duration slices on a dedicated reads track by
+        :func:`to_chrome_trace`."""
+        if not self.sample_every:
+            return False
+        with self._lock:
+            self._read_counter += 1
+            if (self._read_counter - 1) % self.sample_every:
+                return False
+            self._reads.append(dict(replica=int(replica), path=path,
+                                    t0=float(t0), t1=self._clock(),
+                                    group=int(group), status=status))
+            return True
+
     def key_for(self, term: int, index: int,
                 group: int = -1) -> Optional[Tuple[int, int]]:
         with self._lock:
@@ -421,10 +447,16 @@ class SpanRecorder:
         with self._lock:
             spans = ([sp.as_dict() for sp in self._done]
                      + [sp.as_dict() for sp in self._open.values()])
-        return dict(schema=1,
-                    anchor=anchor if anchor is not None else clock_anchor(),
-                    sample_every=self.sample_every,
-                    dropped=self.dropped, spans=spans)
+            reads = [dict(r) for r in self._reads]
+        out = dict(schema=1,
+                   anchor=anchor if anchor is not None else clock_anchor(),
+                   sample_every=self.sample_every,
+                   dropped=self.dropped, spans=spans)
+        if reads:
+            # only when read spans exist: dumps from read-free runs
+            # keep the pre-reads schema byte-for-byte (golden-pinned)
+            out["reads"] = reads
+        return out
 
     def write_json(self, path: str) -> str:
         import os
@@ -443,6 +475,8 @@ class SpanRecorder:
             self._await_apply.clear()
             self._await_ack.clear()
             self._done_pending.clear()
+            self._reads.clear()
+            self._read_counter = 0
             self._counter = 0
             self.dropped = 0
 
@@ -562,6 +596,7 @@ class StepPhaseProfiler:
 # ---------------------------------------------------------------------------
 
 CP_PID = 9999            # the critical-path pseudo-process
+READS_PID = 9998         # the lease/read-index read-span pseudo-process
 
 
 def _span_label(sp: dict) -> str:
@@ -611,6 +646,8 @@ def to_chrome_trace(dumps, *, max_cp_tracks: int = 512,
 
         for sp in d["spans"]:
             walls.extend(wall(ts) for _, _, ts in sp["events"])
+        for rd in d.get("reads", ()):
+            walls.append(wall(rd["t0"]))
         prepared.append((d, wall))
     t0 = (t0_wall if t0_wall is not None
           else (min(walls) if walls else 0.0))
@@ -646,11 +683,28 @@ def to_chrome_trace(dumps, *, max_cp_tracks: int = 512,
                             name=seg, ph="X", ts=us(ta),
                             dur=round(max(tb - ta, 0.0) * 1e6, 3),
                             pid=CP_PID, tid=cp_tid, args=args))
+    n_reads = 0
+    for d, wall in prepared:
+        for rd in d.get("reads", ()):
+            # the read critical path is one slice: enqueue→serve on
+            # the serving replica's reads track
+            n_reads += 1
+            ta, tb = wall(rd["t0"]), wall(rd["t1"])
+            events.append(dict(
+                name=f"read:{rd['path']}", ph="X", ts=us(ta),
+                dur=round(max(tb - ta, 0.0) * 1e6, 3),
+                pid=READS_PID, tid=rd["replica"],
+                args=dict(replica=rd["replica"], path=rd["path"],
+                          group=rd.get("group", -1),
+                          status=rd.get("status"))))
     meta = [dict(name="process_name", ph="M", pid=r, tid=0,
                  args=dict(name=f"replica {r}"))
             for r in sorted(replicas_seen)]
     meta.append(dict(name="process_name", ph="M", pid=CP_PID, tid=0,
                      args=dict(name="critical path")))
+    if n_reads:
+        meta.append(dict(name="process_name", ph="M", pid=READS_PID,
+                         tid=0, args=dict(name="reads")))
     other = dict(tool="rdma_paxos_tpu.obs.spans",
                  dumps=len(prepared),
                  spans=sum(len(d["spans"]) for d, _ in prepared))

@@ -61,6 +61,10 @@ REPAIR_READMITTED = "repair_readmitted"  # probation passed; serving again
 REPAIR_ESCALATED = "repair_escalated"    # bounded retries exhausted (page)
 ALERT_FIRED = "alert_fired"              # SLO alert rule started firing
 ALERT_RESOLVED = "alert_resolved"        # SLO alert rule stopped firing
+LEASE_GRANTED = "lease_granted"          # leader lease activated
+LEASE_RENEWED = "lease_renewed"          # verified-quorum renewal (sampled)
+LEASE_EXPIRED = "lease_expired"          # validity lapsed (no fresh quorum)
+LEASE_REVOKED = "lease_revoked"          # deposed / quarantined / stepped down
 
 
 class TraceEvent(NamedTuple):

@@ -115,7 +115,9 @@ class ClusterDriver:
                  telemetry: bool = False,
                  profile_on_page: float = 0.0,
                  repair: bool = False,
-                 repair_opts: Optional[Dict] = None):
+                 repair_opts: Optional[Dict] = None,
+                 leases: bool = True,
+                 lease_opts: Optional[Dict] = None):
         self.cfg = cfg
         self.sync_period = sync_period
         self._workdir = workdir
@@ -181,6 +183,15 @@ class ClusterDriver:
                                           mode, fanout, audit, telemetry)
         self.cluster.obs = self.obs
         self.cluster.profiler = self._phase_prof
+        # read scaling (runtime/reads.py): step-domain leader leases
+        # renewed by the verified-quorum outputs every step already
+        # carries, plus the queued read hub drained on the readback
+        # thread between pipelined tickets. Host bookkeeping only —
+        # reads never enter begin_*/finish, never consume ring slots,
+        # never change a STEP_CACHE key.
+        if leases:
+            from rdma_paxos_tpu.runtime import reads as _reads
+            _reads.attach(self.cluster, **(lease_opts or {}))
         # SLO alert rules (obs/alerts.py) evaluated on a cadence from
         # the poll loop; firing state rides health snapshots and the
         # alert_firing{alert=...} gauges
@@ -887,6 +898,10 @@ class ClusterDriver:
             audit_artifact=self.audit_artifact,
             repair=(self.repair.status()
                     if self.repair is not None else None),
+            leases=(self.cluster.leases.status()
+                    if self.cluster.leases is not None else None),
+            reads=(self.cluster.reads.status()
+                   if self.cluster.reads is not None else None),
             ts=time.time(),
         )
 
@@ -941,6 +956,10 @@ class ClusterDriver:
                     and self.unverified[r] >= self.step_down_steps):
                 self.stepped_down.add(r)
                 rt = self.runtimes[r]
+                # a majority-less leader must not serve lease reads
+                # either: revoke before the serving gates react
+                if self.cluster.leases is not None:
+                    self.cluster.leases.revoke_all(r, "step_down")
                 self.obs.metrics.inc("step_downs_total", replica=r)
                 self.obs.trace.record(obs_trace.STEP_DOWN, replica=r,
                                       term=int(res["term"][r]),
@@ -1426,7 +1445,11 @@ class ClusterDriver:
         with self._lock:
             return bool(any(self._submitq)
                         or any(len(q) for q in self.cluster.pending)
-                        or self._waiter_count())
+                        or self._waiter_count()
+                        # queued reads need steps to confirm/serve —
+                        # keep the loop running until they resolve
+                        or (self.cluster.reads is not None
+                            and self.cluster.reads.pending_count()))
 
     def _waiter_count(self) -> int:
         """Blocked commit waiters across replicas (caller holds
@@ -1622,6 +1645,9 @@ class ClusterDriver:
                 # PendingEvent is pure host state — safe regardless of
                 # what the wedged thread is doing; a concurrent release
                 # from it is an idempotent no-op) — ADVICE.md #4.
+                if self.cluster.reads is not None:
+                    self.cluster.reads.fail_all(
+                        "stop (wedged poll thread)")
                 with self._lock:
                     n = sum(len(rt.inflight) for rt in self.runtimes)
                     for rt in self.runtimes:
@@ -1647,6 +1673,9 @@ class ClusterDriver:
             self._rb_thread.join(timeout=join_timeout)
         # release commit waiters that were already inflight at stop —
         # nothing will ever step again, so they must fail, not hang
+        # (queued reads the same: no step will ever confirm them)
+        if self.cluster.reads is not None:
+            self.cluster.reads.fail_all("stop")
         with self._lock:
             for rt in self.runtimes:
                 self._fail_inflight_locked(rt, "stop")
@@ -1667,6 +1696,41 @@ class ClusterDriver:
     def leader(self) -> int:
         with self._lock:
             return self._leader_view
+
+    # ------------------------------------------------------------------
+    # the linearizable read queue (runtime/reads.py)
+    # ------------------------------------------------------------------
+
+    def read_replica(self, group: int = 0) -> int:
+        """The replica a linearizable read should target: the group's
+        lease-serving holder (zero-traffic path) when one exists, else
+        the leader (read-index path), else replica 0 (the hub confirms
+        before serving, so a bad default only costs latency)."""
+        lm = self.cluster.leases
+        r = lm.serving_holder(group) if lm is not None else -1
+        if r < 0:
+            r = self.leader()
+        return r if r >= 0 else 0
+
+    def read(self, fn=None, *, replica: Optional[int] = None,
+             group: int = 0, timeout: float = 30.0):
+        """Queue one linearizable read and block until it serves (or
+        fails). ``fn()`` runs AT the linearization point — on the
+        readback thread, against the serving replica's applied state —
+        and its return value lands on the returned ticket. Reads never
+        enter ``begin_*``/``finish`` and never consume ring slots; an
+        idle loop is woken so the confirming step dispatches
+        immediately."""
+        hub = self.cluster.reads
+        if hub is None:
+            raise RuntimeError(
+                "driver was built with leases=False — no read path")
+        if replica is None:
+            replica = self.read_replica(group)
+        t = hub.submit(fn, replica=replica, group=group)
+        self._wake.set()
+        t.wait(timeout)
+        return t
 
     def can_serve_read(self, r: int) -> bool:
         """Read-index check: True iff replica ``r`` verified its

@@ -84,7 +84,8 @@ class RepairController:
     def __init__(self, cluster, *, obs=None, probation_steps: int = 6,
                  max_attempts: int = 3, backoff_steps: int = 8,
                  min_verified: int = 1, install_hook=None,
-                 post_install=None):
+                 post_install=None, storm_policy: bool = False,
+                 storm_min: int = 3):
         if cluster.auditor is None:
             raise ValueError("repair requires an audit=True cluster "
                              "(the ledger is the donor-selection and "
@@ -137,6 +138,18 @@ class RepairController:
         self.repairs_done = 0
         self.donors_rejected = 0
         self.escalations = 0
+        # telemetry-triggered quarantine policy (opt-in): a firing
+        # election_storm page (device-truth elections_started rate,
+        # obs/device.py series) quarantines the storming replica
+        # WITHOUT a digest finding — link cut + serving/lease/read
+        # refusal + probation, but no snapshot re-install (its state
+        # never diverged; the storm is a liveness hazard, not a
+        # correctness one)
+        self.storm_policy = bool(storm_policy)
+        self.storm_min = int(storm_min)
+        self._storm_prev: Dict[str, float] = {}
+        self._storm_tick = 0
+        self.policy_quarantines = 0
 
     # ------------------------------------------------------------------
     # helpers over the two engine shapes
@@ -189,6 +202,28 @@ class RepairController:
                 pm[r, p] = 1
                 pm[p, r] = 1
 
+    def _block_reads(self, g: int, r: int) -> None:
+        """Bar ``(g, r)`` from read serving for the WHOLE hold
+        (quarantine through probation): ``need_recovery`` alone does
+        not cover policy holds (their replay keeps running) and is
+        discarded at install time, before probation ends."""
+        rb = getattr(self.cluster, "read_blocked", None)
+        if rb is not None:
+            rb.add(self._key_of_recovery(g, r))
+
+    def _unblock_reads(self, g: int, r: int) -> None:
+        rb = getattr(self.cluster, "read_blocked", None)
+        if rb is not None:
+            rb.discard(self._key_of_recovery(g, r))
+
+    def _revoke_lease(self, g: int, r: int) -> None:
+        """A held replica must not serve lease reads: revoke BEFORE
+        serving gates react (runtime/reads.py — revocation arms the
+        wait-out barrier so no successor lease activates early)."""
+        lm = getattr(self.cluster, "leases", None)
+        if lm is not None:
+            lm.revoke(g, r, reason="quarantine")
+
     def _gauge(self, g: int, r: int, v: int) -> None:
         if self.obs is not None:
             self.obs.metrics.set("replica_quarantined", v,
@@ -215,6 +250,17 @@ class RepairController:
         """Consume new ledger findings (quarantine newly implicated
         minority replicas) and advance probation hysteresis — host
         bookkeeping only; never touches device state."""
+        # keep the storm-attribution baseline FRESH on a stride: the
+        # deltas _storm_replicas reads must reflect recent elections,
+        # not lifetime totals — an un-refreshed baseline would blame
+        # whichever replica churned most EVER (e.g. early-run leader
+        # churn) instead of the replica storming NOW. The stride keeps
+        # the per-step registry snapshot off most observe passes.
+        if self.storm_policy and self.obs is not None:
+            self._storm_tick += 1
+            if self._storm_tick % 8 == 0:
+                with self._lock:
+                    self._storm_refresh()
         newly_q: List[Tuple[int, int]] = []
         with self._lock:
             findings = self.led.findings
@@ -271,7 +317,9 @@ class RepairController:
         c = self.cluster
         with c._host_lock:
             c.need_recovery.add(self._key_of_recovery(g, r))
+            self._block_reads(g, r)
             self._cut_mask(g, r)
+        self._revoke_lease(g, r)
         attempts = st["attempts"] if st is not None else 0
         self.states[key] = dict(
             state=QUARANTINED, attempts=attempts,
@@ -336,6 +384,19 @@ class RepairController:
     def _repair_one(self, key: Tuple[int, int]) -> bool:
         g, r = key
         st = self.states[key]
+        if st.get("policy"):
+            # policy quarantine (no digest finding): the replica's
+            # state never diverged, so there is nothing to re-install
+            # or backfill — restore its links and let the clean-step
+            # probation hysteresis gate re-admission (a repeat storm
+            # during probation re-quarantines via on_alert)
+            with self.cluster._host_lock:
+                self._restore_mask(g, r)
+            st.update(state=PROBATION, clean=0, pending=None,
+                      last_step=self._step_index())
+            self._mark("repair_policy_released", g, r,
+                       reason=st["finding"].get("reason"))
+            return True
         for donor in self._donor_candidates(g, r):
             try:
                 snap_info = self._install_from(g, r, donor)
@@ -506,6 +567,7 @@ class RepairController:
     def _readmit(self, key: Tuple[int, int]) -> None:
         g, r = key
         del self.states[key]
+        self._unblock_reads(g, r)
         self._gauge(g, r, 0)
         if self.obs is not None:
             self.obs.metrics.inc("repair_readmitted_total", group=g)
@@ -537,16 +599,111 @@ class RepairController:
             return {self._key_of_recovery(g, r)
                     for (g, r) in self.states}
 
+    def blocked_replicas_locked(self, group: int) -> Set[int]:
+        """Caller holds ``self._lock``."""
+        return {r for (g, r) in self.states if g == group}
+
     def blocked_replicas(self, group: int = 0) -> Set[int]:
         with self._lock:
-            return {r for (g, r) in self.states if g == group}
+            return self.blocked_replicas_locked(group)
 
     def on_alert(self, name: str, severity: str) -> None:
         """Alert→action hook (``AlertEngine.add_hook``): a firing
         digest-divergence page triggers an immediate findings scan so
-        quarantine never waits for the next step's observe pass."""
+        quarantine never waits for the next step's observe pass; a
+        firing election-storm page (with ``storm_policy=True``)
+        quarantines the storming replica without a digest finding."""
         if name == "digest_divergence":
             self.observe()
+        elif name == "election_storm" and self.storm_policy:
+            self._storm_quarantine()
+
+    def _storm_refresh(self) -> Dict[Tuple[int, int], float]:
+        """Advance the per-series storm baseline and return the
+        per-(group, replica) deltas since the previous refresh — read
+        from the registry's
+        ``device_elections_started_total{replica=,group=}`` series
+        (the PR 8 device-truth surface the election_storm rule fires
+        on)."""
+        from rdma_paxos_tpu.obs.alerts import _split_key
+        deltas: Dict[Tuple[int, int], float] = {}
+        snap = self.obs.metrics.snapshot()["counters"]
+        for key, total in snap.items():
+            base, labels = _split_key(key)
+            if base != "device_elections_started_total":
+                continue
+            delta = total - self._storm_prev.get(key, 0)
+            self._storm_prev[key] = total
+            if delta <= 0:
+                continue
+            gr = (int(labels.get("group", 0)),
+                  int(labels.get("replica", -1)))
+            if gr[1] >= 0:
+                deltas[gr] = deltas.get(gr, 0) + delta
+        return deltas
+
+    def _storm_replicas(self) -> List[Tuple[int, int]]:
+        """The replicas whose DEVICE election counter advanced most
+        since the last baseline refresh (recent activity, not
+        lifetime totals — observe() keeps the baseline fresh)."""
+        if self.obs is None:
+            return []
+        deltas = self._storm_refresh()
+        worst = max(deltas.values(), default=0)
+        if worst < self.storm_min:
+            return []
+        return sorted(gr for gr, d in deltas.items() if d == worst)
+
+    def _storm_quarantine(self) -> List[Tuple[int, int]]:
+        held = []
+        with self._lock:
+            for (g, r) in self._storm_replicas():
+                # never cut the group below a connected majority: the
+                # digest path holds one implicated minority finding at
+                # a time, and the policy path gets the same budget —
+                # two rivals storming in lock-step must not combine
+                # into a self-inflicted total outage
+                already = len(self.blocked_replicas_locked(g))
+                if already + 1 > (self.R - 1) // 2:
+                    self._mark("storm_hold_refused", g, r,
+                               held=already)
+                    continue
+                if self._policy_quarantine(g, r, "election_storm"):
+                    held.append((g, r))
+        if self.on_quarantine is not None:
+            for (g, r) in held:        # hooks outside our lock
+                try:
+                    self.on_quarantine(g, r)
+                except Exception:  # noqa: BLE001 — hooks never kill
+                    pass           # the alert-evaluating poll loop
+        return held
+
+    def _policy_quarantine(self, g: int, r: int,
+                           reason: str) -> bool:
+        """Quarantine WITHOUT a digest finding (caller holds our
+        lock): link cut + serving/lease refusal, but the replica's
+        replay keeps running (its state is not suspect) and drive()
+        releases it straight to probation — no install, no
+        backfill."""
+        if (g, r) in self.states:
+            return False            # already held / escalated
+        with self.cluster._host_lock:
+            self._cut_mask(g, r)
+            self._block_reads(g, r)
+        self._revoke_lease(g, r)
+        step = self._step_index()
+        self.states[(g, r)] = dict(
+            state=QUARANTINED, attempts=0, next_try=step, clean=0,
+            finding=dict(type="POLICY", reason=reason),
+            last_step=step, policy=True)
+        self.policy_quarantines += 1
+        self._gauge(g, r, 1)
+        if self.obs is not None:
+            self.obs.metrics.inc("replicas_policy_quarantined_total",
+                                 replica=r, group=g)
+        self._mark("replica_quarantined", g, r, policy=True,
+                   reason=reason)
+        return True
 
     def status(self) -> dict:
         """Deterministic (step-domain, no wall clock) state export for
@@ -558,6 +715,7 @@ class RepairController:
                 repairs_done=self.repairs_done,
                 donors_rejected=self.donors_rejected,
                 escalations=self.escalations,
+                policy_quarantines=self.policy_quarantines,
                 probation_steps=self.probation_steps,
                 max_attempts=self.max_attempts,
                 timeline=[dict(t) for t in self.timeline],

@@ -295,7 +295,9 @@ class ShardedClusterDriver(ClusterDriver):
     def _busy(self) -> bool:
         with self._lock:
             return bool(any(self._submitq) or self._backlog()
-                        or self._waiter_count())
+                        or self._waiter_count()
+                        or (self.cluster.reads is not None
+                            and self.cluster.reads.pending_count()))
 
     def step(self) -> Dict:
         """One host-loop iteration: elections for leaderless groups
@@ -579,8 +581,36 @@ class ShardedClusterDriver(ClusterDriver):
             audit_artifact=self.audit_artifact,
             repair=(self.repair.status()
                     if self.repair is not None else None),
+            reads=(self.cluster.reads.status()
+                   if self.cluster.reads is not None else None),
             ts=time.time())
         return h
+
+    def read(self, fn=None, *, key=None, group: Optional[int] = None,
+             replica: Optional[int] = None, timeout: float = 30.0):
+        """Queue one linearizable read against the group owning
+        ``key`` (or an explicit ``group``). The serving replica
+        defaults to that group's lease holder — which
+        ``place_leaders`` spreads across the R replicas, so read load
+        fans out instead of piling onto one front-end. Same hub
+        contract as the single-group driver: served on the readback
+        thread between pipelined tickets, never through the log."""
+        if group is None:
+            if key is None:
+                raise ValueError("read needs key= or group=")
+            group = self._router.group_of(key)
+        if replica is None:
+            replica = self.read_replica(group)
+        return super().read(fn, replica=replica, group=group,
+                            timeout=timeout)
+
+    def read_replica(self, group: int = 0) -> int:
+        lm = self.cluster.leases
+        r = lm.serving_holder(group) if lm is not None else -1
+        if r < 0:
+            with self._lock:
+                r = self._group_views[group]
+        return r if r >= 0 else 0
 
     def can_serve_read(self, r: int) -> bool:
         """True iff replica ``r`` verified its leadership on the latest

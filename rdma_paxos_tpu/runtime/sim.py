@@ -427,6 +427,20 @@ class SimCluster:
         # are unchanged (tests/test_chaos.py guards it). step_index is
         # the logical clock the model's per-step randomness keys on.
         self.link_model = None
+        # read-path subsystem (runtime/reads.py, attached via
+        # reads.attach): step-domain leader leases observed — and the
+        # queued read hub drained — at the tail of every finish(),
+        # which under the pipelined driver is the readback thread.
+        # Pure host bookkeeping: never enters jitted code, adds no
+        # STEP_CACHE keys (tests/test_reads.py pins it).
+        self.leases = None
+        self.reads = None
+        # replicas barred from SERVING reads by the repair pipeline
+        # (digest quarantine AND the storm policy, whose holds leave
+        # replay running and so never enter need_recovery) — consulted
+        # by the KVS serving gate and the read hub; keys match
+        # need_recovery's shape (r here, (g, r) on the sharded engine)
+        self.read_blocked: set = set()
         self.step_index = 0
         # dispatch-side logical clock: advances at begin_* (step_index
         # advances at finish) so an in-flight pipeline never feeds the
@@ -745,6 +759,13 @@ class SimCluster:
             self.last = res
         self.step_index += ticket.K
         self._observe_spans(res)
+        # read path: renew/revoke leases from this FINISHED step's
+        # verified-quorum outputs, then serve due queued reads —
+        # between pipelined tickets, never inside one
+        if self.leases is not None:
+            self.leases.observe(self, res)
+        if self.reads is not None:
+            self.reads.drain(self)
         if burst:
             B = self.cfg.batch_slots
             self._staging.release(ticket.bufs, [

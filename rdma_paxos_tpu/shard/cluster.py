@@ -228,6 +228,16 @@ class ShardedCluster:
         # optional per-group chaos link models (g -> LinkModel); purely
         # host-side input rewrites, like SimCluster.link_model
         self.link_models: Dict[int, object] = {}
+        # read-path subsystem (runtime/reads.py): per-group leader
+        # leases + queued read hub, observed/drained at the tail of
+        # every finish() — same contract (and same attach()) as
+        # SimCluster, widened by the group axis, so place_leaders
+        # spreads lease-read serving across the R replicas
+        self.leases = None
+        self.reads = None
+        # repair-held replicas barred from read serving ({(g, r)} —
+        # see SimCluster.read_blocked)
+        self.read_blocked: set = set()
         self.step_index = 0
         # host-side observability facade; NEVER read inside jitted code
         self.obs = None
@@ -630,6 +640,13 @@ class ShardedCluster:
             self.last = res
         self.step_index += ticket.K
         self._observe(res)
+        # read path: per-group lease renew/revoke from the finished
+        # step, then serve due queued reads (readback thread under
+        # the pipelined driver — same contract as SimCluster)
+        if self.leases is not None:
+            self.leases.observe(self, res)
+        if self.reads is not None:
+            self.reads.drain(self)
         if burst:
             self._staging.release(ticket.bufs, [
                 ((k, g, r), min(B, len(t) - k * B))
@@ -958,7 +975,9 @@ class ShardedCluster:
                                         for d in self.mesh.devices.flat])),
                     router=self.router.to_dict(), groups=groups,
                     audit=(self.auditor.summary()
-                           if self.auditor is not None else None))
+                           if self.auditor is not None else None),
+                    leases=(self.leases.status()
+                            if self.leases is not None else None))
 
     # ---------------- leadership ----------------
 

@@ -49,6 +49,29 @@ class _GroupFacade:
     def obs(self):
         return self._shard.obs
 
+    @property
+    def leases(self):
+        """The sharded cluster's per-group LeaseManager (or None) —
+        the single-group KVS consults it with this facade's group, so
+        lease-path reads work identically through the facade."""
+        return getattr(self._shard, "leases", None)
+
+    @property
+    def need_recovery(self):
+        """This group's slice of the sharded ``{(g, r)}`` recovery
+        set, in the single-group ``{r}`` shape the KVS serving gate
+        consults."""
+        return {r for (g, r) in self._shard.need_recovery
+                if g == self.group}
+
+    @property
+    def read_blocked(self):
+        """This group's slice of the repair pipeline's read-serving
+        bar (same shape translation as ``need_recovery``)."""
+        return {r for (g, r) in getattr(self._shard, "read_blocked",
+                                        ())
+                if g == self.group}
+
     def span_replica(self, r: int) -> int:
         """Namespaced span-track id for this group's replica ``r`` —
         the SAME ``g*R + r`` namespace the sharded cluster's
@@ -59,6 +82,13 @@ class _GroupFacade:
     @property
     def replayed(self):
         return self._shard.replayed[self.group]
+
+    @property
+    def applied(self):
+        """This group's ``[R]`` host apply cursors (the serving
+        frontier gate in ``ReplicatedKVS.get`` compares them against
+        the group's commit indices)."""
+        return self._shard.applied[self.group]
 
     @property
     def last(self):
@@ -141,12 +171,20 @@ class ShardedKVS:
 
     def get(self, key: bytes, *, linearizable: bool = False,
             replica: Optional[int] = None) -> Optional[bytes]:
-        """Read ``key`` from its group. Linearizable reads go to the
-        group's leader (read-index rule applies there); weak reads go
-        to ``replica`` (or the leader by default) of that group."""
+        """Read ``key`` from its group. Linearizable reads default to
+        the group's lease-serving replica (the holder — how
+        ``place_leaders`` spreads read serving across the R replicas)
+        falling back to the leader for the read-index path; weak
+        reads go to ``replica`` (or the leader by default)."""
         g = self.group_of(key)
         if replica is None:
-            replica = self.shard.leader_hint(g)
+            lm = getattr(self.shard, "leases", None)
+            if linearizable and lm is not None:
+                replica = lm.serving_holder(g)
+            else:
+                replica = -1
+            if replica < 0:
+                replica = self.shard.leader_hint(g)
             if replica < 0:
                 replica = 0
         return self.groups[g].get(replica, key,
