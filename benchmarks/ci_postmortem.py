@@ -1,0 +1,83 @@
+"""CI failure postmortem — boot a smoke cluster, dump every obs
+surface, assemble ONE verified bundle.
+
+When the tier-1 suite fails in CI, the raw pytest log says WHAT
+failed but nothing about the environment it failed in. This script
+(the workflow's ``if: failure()`` step) runs a short in-process
+cluster session with the full ops plane attached, forces every dump
+surface to disk (series JSONL, span dump, audit artifact, trace
+ring, metrics snapshot, health files), and assembles them into one
+``postmortem_bundle`` artifact via the fleet console — so the upload
+carries a machine-checkable environment smoke (did elections work?
+did commits flow? what did the burn-rate rules see?) next to the
+test log. The last lines of the failing log ride in the bundle's
+``reason``.
+
+Usage: ``python benchmarks/ci_postmortem.py --out bundle.json
+[--log /tmp/_t1.log]`` — exits 0 iff the assembled bundle verifies.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="tier1_bundle.json")
+    ap.add_argument("--log", default=None,
+                    help="failing test log; its tail becomes the "
+                         "bundle reason")
+    ap.add_argument("--steps", type=int, default=30)
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+    reason = "tier1 failure"
+    if args.log and os.path.exists(args.log):
+        with open(args.log, errors="replace") as f:
+            tail = f.readlines()[-15:]
+        reason = "tier1 failure; log tail:\n" + "".join(tail)
+
+    from rdma_paxos_tpu.config import LogConfig, TimeoutConfig
+    from rdma_paxos_tpu.obs import console
+    from rdma_paxos_tpu.obs.audit import write_audit_artifact
+    from rdma_paxos_tpu.runtime.driver import ClusterDriver
+
+    wd = tempfile.mkdtemp(prefix="rp_ci_postmortem_")
+    cfg = LogConfig(n_slots=256, slot_bytes=128, window_slots=64,
+                    batch_slots=16)
+    d = ClusterDriver(cfg, 3, workdir=wd, timeout_cfg=TimeoutConfig(),
+                      fanout="psum", audit=True, health_period=0.0)
+    d.cluster.run_until_elected(0)
+    for i in range(args.steps):
+        d.cluster.submit(0, b"ci-smoke-%d" % i)
+        d.step()
+    d.evaluate_alerts()
+    d.obs.spans.write_json(os.path.join(wd, "spans.json"))
+    write_audit_artifact(os.path.join(wd, "audit_dump.json"),
+                         reason="ci postmortem smoke",
+                         ledger=d.cluster.auditor,
+                         flight=d.cluster.flight, obs=d.obs)
+    d.obs.trace.dump_on_failure(os.path.join(wd, "trace_dump.json"),
+                                reason="ci postmortem smoke")
+    d.obs.metrics.write_json(os.path.join(wd, "metrics.json"))
+    d.stop()
+
+    rc = console.main(["bundle", "--workdir", wd, "--out", args.out,
+                       "--reason", reason])
+    if rc != 0:
+        return rc
+    return console.main(["bundle", "--verify", args.out])
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

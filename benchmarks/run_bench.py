@@ -117,6 +117,73 @@ def measure_telemetry_overhead(cfg=None, **kw):
     return _measure_flag_overhead("telemetry", proof, cfg, **kw)
 
 
+def measure_export_overhead(cfg=None, *, sample_period_s=0.25,
+                            scrape_period_s=0.5, **kw):
+    """A/B the whole ops-plane host addition (the <2% acceptance
+    target): the ON variant samples the registry into a
+    TimeSeriesStore + evaluates the full default rule set (burn-rate
+    SLO rules included) on the drivers' 0.25 s alert cadence AND
+    answers a live ``/metrics`` scrape every ``scrape_period_s`` —
+    the production configuration, measured wall-cadenced exactly as
+    the drivers run it. The OFF variant is the bare cluster.
+    Alternating best-of rounds, the shared methodology."""
+    import time as _time
+    import urllib.request
+
+    from rdma_paxos_tpu.obs import Observability
+    from rdma_paxos_tpu.obs.alerts import AlertEngine, default_rules
+    from rdma_paxos_tpu.obs.export import OpsExporter
+    from rdma_paxos_tpu.obs.series import TimeSeriesStore
+    from rdma_paxos_tpu.runtime.sim import SimCluster
+
+    handles = {}
+
+    def make(variant, cfg, n_replicas):
+        c = SimCluster(cfg, n_replicas, fanout="psum")
+        c.obs = Observability()
+        c.run_until_elected(0)
+        if variant == "on":
+            store = TimeSeriesStore(capacity=256)
+            eng = AlertEngine(c.obs.metrics, rules=default_rules(),
+                              series=store)
+            exp = OpsExporter(registry=c.obs.metrics, alerts=eng,
+                              series=store,
+                              health_fn=lambda: dict(ok=True)).start()
+            handles[id(c)] = dict(store=store, eng=eng, exp=exp,
+                                  n=0, scrapes=0,
+                                  t_sample=float("-inf"),
+                                  t_scrape=float("-inf"))
+        return c
+
+    def after_step(variant, c):
+        h = handles.get(id(c))
+        if h is None:
+            return
+        h["n"] += 1
+        now = _time.monotonic()
+        if now - h["t_sample"] >= sample_period_s:
+            h["t_sample"] = now
+            snap = c.obs.metrics.snapshot()
+            h["store"].sample(snap, step=h["n"])
+            h["eng"].evaluate(snap=snap)
+        if now - h["t_scrape"] >= scrape_period_s:
+            h["t_scrape"] = now
+            urllib.request.urlopen(h["exp"].url + "/metrics",
+                                   timeout=10).read()
+            h["scrapes"] += 1
+
+    def proof(on_c, out):
+        h = handles[id(on_c)]
+        out["export"] = dict(samples=h["store"].samples,
+                             series=len(h["store"].names()),
+                             rule_evals=h["eng"].evals,
+                             scrapes=h["scrapes"])
+        h["exp"].close()
+
+    return _measure_flag_overhead("export", proof, cfg, make=make,
+                                  after_step=after_step, **kw)
+
+
 def measure_repair(cfg=None, *, n_replicas=3, steps=300, per_step=8,
                    payload=64, warmup=10, repeats=3,
                    corrupt_after=40, probation=6, mttr_budget=400):
@@ -482,12 +549,23 @@ def main():
                          "clock anchors")
     ap.add_argument("--profile-secs", type=float, default=60.0,
                     help="hard bound on the --profile capture")
+    ap.add_argument("--serve-metrics", nargs="?", const=0,
+                    default=None, type=int, metavar="PORT",
+                    help="serve the live ops endpoints (/metrics "
+                         "/healthz /series /alerts) on this localhost "
+                         "port for the whole run (no value = "
+                         "ephemeral) — watch a long bench with the "
+                         "fleet console or any Prometheus scraper; "
+                         "also emits the export_overhead_pct A/B row "
+                         "(series+rules+scrape on vs off, target "
+                         "<2%%)")
     args = ap.parse_args()
 
     sharded_e2e = bool(args.groups) and (
         args.e2e or args.fence or args.audit or args.metrics_json
         or args.threaded_app or args.trace or args.trace_json
-        or args.telemetry or args.profile)
+        or args.telemetry or args.profile
+        or args.serve_metrics is not None)
     if args.groups and not sharded_e2e:
         # plain --groups N: the sharded SIM sweep (shard_bench owns its
         # own cluster lifecycle). Any e2e flag routes to the sharded
@@ -543,6 +621,11 @@ def main():
         # so a full run's spans are retained for the export
         driver.obs.spans.resize(max(args.requests * 2, 4096))
         driver.obs.spans.set_sample_every(1)
+    if args.serve_metrics is not None:
+        exp = driver.serve_metrics(args.serve_metrics)
+        print(f"ops endpoints: {exp.url}/metrics  /healthz  /series  "
+              f"/alerts  (fleet console: python -m "
+              f"rdma_paxos_tpu.obs.console --scrape {exp.url})")
     print("prewarming step/burst compiles...")
     driver.prewarm()
     apps = []
@@ -886,6 +969,19 @@ def main():
              obs=driver.obs, json_path=args.json)
         emit("lease_read_speedup", rm["lease_read_speedup"], "x",
              detail=rm, obs=driver.obs, json_path=args.json)
+
+    if args.serve_metrics is not None:
+        # ops-plane overhead on the now-quiet process (the
+        # --telemetry reasoning): series sampling + full rule set +
+        # live scrapes on vs the bare cluster — target <2%
+        ab = measure_export_overhead()
+        print(f"export overhead: {ab['off']['ops_per_sec']} ops/s "
+              f"off vs {ab['on']['ops_per_sec']} ops/s on "
+              f"({ab['overhead_pct']}% — target <2%)")
+        emit("export_overhead_pct", ab["overhead_pct"], "%",
+             detail=dict(off=ab["off"], on=ab["on"],
+                         export=ab["export"]),
+             obs=driver.obs, json_path=args.json)
 
     if args.telemetry:
         # counters on vs off, alternating best-of (the PR 5 audit

@@ -35,7 +35,7 @@ def run_one(G: int, *, replicas: int, steps: int, payload: int,
             burst: bool, json_path, cfg=None, mesh=None,
             telemetry: bool = False, read_ratio: float = 0.0,
             metric="shard_aggregate_committed_ops_per_sec",
-            extra_detail=None):
+            extra_detail=None, obs=None, on_cluster=None):
     """Build, warm, and drive one G-group cluster; returns the result
     row dict (also emitted as a BENCH: line). ``mesh=(group_shards,
     replicas)`` runs the MULTI-CHIP engine — state sharded over a real
@@ -53,7 +53,12 @@ def run_one(G: int, *, replicas: int, steps: int, payload: int,
                         window_slots=256, batch_slots=256)
     sc = ShardedCluster(cfg, replicas, G, mesh=mesh,
                         telemetry=telemetry)
-    sc.obs = Observability()
+    # a shared obs facade (--serve-metrics) keeps one registry across
+    # the whole sweep so the live exporter's view survives cluster
+    # swaps; on_cluster re-points the /healthz source at each new one
+    sc.obs = obs if obs is not None else Observability()
+    if on_cluster is not None:
+        on_cluster(sc)
     targets = sc.place_leaders()
     B = cfg.batch_slots
     blob = b"x" * payload
@@ -197,7 +202,8 @@ def run_one(G: int, *, replicas: int, steps: int, payload: int,
 
 def run_mesh_sweep(layouts, *, groups_per_shard: int, steps: int,
                    payload: int, burst: bool, json_path,
-                   read_ratio: float = 0.0) -> int:
+                   read_ratio: float = 0.0, obs=None,
+                   on_cluster=None) -> int:
     """The multi-chip layout sweep: each ``GSxR`` layout runs G =
     GS * groups_per_shard groups over a real ``(group, replica)``
     device mesh of GS*R devices, A/B'd against a SINGLE-chip baseline
@@ -230,7 +236,8 @@ def run_mesh_sweep(layouts, *, groups_per_shard: int, steps: int,
                 payload=payload, burst=burst, json_path=json_path,
                 telemetry=True, read_ratio=read_ratio,
                 metric="mesh_baseline_committed_ops_per_sec",
-                extra_detail=dict(role="single-chip baseline"))
+                extra_detail=dict(role="single-chip baseline"),
+                obs=obs, on_cluster=on_cluster)
             baselines[R] = base["value"]
         row = run_one(
             gs * groups_per_shard, replicas=R, steps=steps,
@@ -238,7 +245,8 @@ def run_mesh_sweep(layouts, *, groups_per_shard: int, steps: int,
             mesh=(gs, R), telemetry=True, read_ratio=read_ratio,
             metric="mesh_aggregate_committed_ops_per_sec",
             extra_detail=dict(layout=f"{gs}x{R}", group_shards=gs,
-                              devices=gs * R))
+                              devices=gs * R),
+            obs=obs, on_cluster=on_cluster)
         eff = row["value"] / max(gs * baselines[R], 1e-9)
         emit("mesh_scaling_efficiency", round(eff, 3), "ratio",
              detail=dict(
@@ -299,6 +307,13 @@ def main(argv=None) -> int:
                          "reads_per_replica in every row")
     ap.add_argument("--json", default=None,
                     help="append JSON result rows to this file")
+    ap.add_argument("--serve-metrics", nargs="?", const=0,
+                    default=None, type=int, metavar="PORT",
+                    help="serve live /metrics + /healthz on this "
+                         "localhost port for the whole sweep (no "
+                         "value = ephemeral port) — watch a long "
+                         "bench with the fleet console or any "
+                         "Prometheus scraper")
     args = ap.parse_args(argv)
 
     os.environ.setdefault(
@@ -309,6 +324,24 @@ def main(argv=None) -> int:
         jax.config.update("jax_platforms", "cpu")
 
     from benchmarks.reporting import emit
+
+    exporter = None
+    shared_obs = None
+    on_cluster = None
+    if args.serve_metrics is not None:
+        from rdma_paxos_tpu.obs import Observability
+        from rdma_paxos_tpu.obs.export import OpsExporter
+        shared_obs = Observability()
+        holder = {}
+
+        def on_cluster(sc):
+            holder["c"] = sc
+        exporter = OpsExporter(
+            registry=shared_obs.metrics,
+            health_fn=lambda: (holder["c"].health() if "c" in holder
+                               else dict(ok=True)),
+            port=args.serve_metrics).start()
+        print(f"ops endpoints: {exporter.url}/metrics  /healthz")
 
     if args.mesh:
         if args.groups is not None or args.replicas is not None:
@@ -330,11 +363,15 @@ def main(argv=None) -> int:
                 raise SystemExit(
                     f"--mesh: bad layout {tok!r} — expected "
                     f'comma-separated "GSxR" tokens, e.g. "1x2,2x2,4x2"')
-        return run_mesh_sweep(layouts,
-                              groups_per_shard=args.groups_per_shard,
-                              steps=args.steps, payload=args.payload,
-                              burst=args.burst, json_path=args.json,
-                              read_ratio=args.read_ratio)
+        rc = run_mesh_sweep(layouts,
+                            groups_per_shard=args.groups_per_shard,
+                            steps=args.steps, payload=args.payload,
+                            burst=args.burst, json_path=args.json,
+                            read_ratio=args.read_ratio,
+                            obs=shared_obs, on_cluster=on_cluster)
+        if exporter is not None:
+            exporter.close()
+        return rc
 
     if args.groups is None:
         args.groups = "1,2,4,8"
@@ -349,7 +386,8 @@ def main(argv=None) -> int:
         row = run_one(G, replicas=args.replicas, steps=args.steps,
                       payload=args.payload, burst=args.burst,
                       json_path=args.json,
-                      read_ratio=args.read_ratio)
+                      read_ratio=args.read_ratio,
+                      obs=shared_obs, on_cluster=on_cluster)
         scaling[G] = row
     emit("shard_scaling",
          detail={str(G): dict(
@@ -361,6 +399,8 @@ def main(argv=None) -> int:
     for G in gs[1:]:
         speedup = scaling[G]["value"] / max(scaling[base]["value"], 1e-9)
         print(f"  aggregate G={G} vs G={base}: {speedup:.2f}x")
+    if exporter is not None:
+        exporter.close()
     return 0
 
 

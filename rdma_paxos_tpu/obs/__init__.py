@@ -34,6 +34,19 @@ Three parts, all host-side, all zero-dependency (stdlib only):
   compiled steps), the bounded ``jax.profiler`` capture manager, the
   merged span/host-phase/device Perfetto timeline, and per-variant
   compiled-program cost reports.
+* :mod:`~rdma_paxos_tpu.obs.series` — time-series retention: the
+  registry sampled on the alert cadence into bounded per-series rings
+  (counters→windowed rates, gauges→last, histograms→quantile/CDF
+  points), persisted as append-only JSONL (cross-host merge = file
+  concat) — the substrate of the window-domain SLO rules.
+* :mod:`~rdma_paxos_tpu.obs.export` — metrics exposition: the
+  Prometheus text renderer and the opt-in localhost HTTP exporter
+  (``/metrics`` ``/healthz`` ``/series`` ``/alerts``) the drivers and
+  NodeDaemon attach.
+* :mod:`~rdma_paxos_tpu.obs.console` — the operator CLI: a live fleet
+  table merged from N hosts' health files / scraped endpoints, and
+  one-command sha256-manifested postmortem bundles
+  (``python -m rdma_paxos_tpu.obs.console``).
 
 HARD RULE: no metrics/trace call may execute inside a
 jitted/``shard_map``ped function — instrumentation lives in the host
@@ -47,12 +60,15 @@ from __future__ import annotations
 from typing import Optional
 
 from rdma_paxos_tpu.obs import (
-    alerts, audit, clock, device, health, metrics, spans, trace)
+    alerts, audit, clock, device, export, health, metrics, series,
+    spans, trace)
 from rdma_paxos_tpu.obs.alerts import AlertEngine
 from rdma_paxos_tpu.obs.audit import AuditLedger, FlightRecorder
 from rdma_paxos_tpu.obs.device import ProfilerSession
+from rdma_paxos_tpu.obs.export import OpsExporter
 from rdma_paxos_tpu.obs.health import HealthReporter
 from rdma_paxos_tpu.obs.metrics import MetricsRegistry
+from rdma_paxos_tpu.obs.series import TimeSeriesStore
 from rdma_paxos_tpu.obs.spans import SpanRecorder, StepPhaseProfiler
 from rdma_paxos_tpu.obs.trace import TraceRing
 
@@ -105,6 +121,6 @@ def default() -> Observability:
 __all__ = ["Observability", "MetricsRegistry", "TraceRing",
            "HealthReporter", "SpanRecorder", "StepPhaseProfiler",
            "AuditLedger", "FlightRecorder", "AlertEngine",
-           "ProfilerSession",
+           "ProfilerSession", "TimeSeriesStore", "OpsExporter",
            "default", "metrics", "trace", "health", "spans", "clock",
-           "audit", "alerts", "device"]
+           "audit", "alerts", "device", "series", "export"]

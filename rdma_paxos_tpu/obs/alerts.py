@@ -24,6 +24,26 @@ Rule kinds:
   series with the same name are merged — same ladder by design) and
   compares it against ``threshold`` with ``op``.
 
+Two WINDOW-DOMAIN kinds evaluate against the attached
+:class:`~rdma_paxos_tpu.obs.series.TimeSeriesStore` (``series=``)
+instead of the instantaneous snapshot — without a store they are
+silent, the same contract the telemetry-backed rules use when the
+device series don't exist:
+
+* ``rate_window`` — the counter's average per-second rate over the
+  trailing ``window_s`` (or ``window_steps``) exceeds ``threshold``
+  (windows anchor at the series' last sample — step+wall domain of
+  the DATA, deterministic, not the realtime clock).
+* ``burn_rate`` — multi-window SLO burn rate over a latency
+  histogram: the fraction of observations above ``bound`` (a bucket
+  boundary) in a window, divided by the error budget
+  ``1 - objective``. Fires only when BOTH the fast window
+  (``fast_window_s``) and the slow window (``slow_window_s``) burn
+  faster than ``burn_threshold`` — the fast window catches the
+  regression quickly, the slow window keeps a transient blip from
+  paging (the classic multi-window burn-rate pager), and
+  ``for_evals`` hysteresis still applies on top.
+
 Metric matching aggregates across label sets by default (counters are
 summed, gauges take the configured ``agg`` — max by default);
 ``labels={...}`` restricts a rule to exact label pairs.
@@ -38,13 +58,14 @@ ring is attached.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 PAGE = "page"
 WARN = "warn"
 
 KINDS = ("counter_nonzero", "counter_rate", "gauge_cmp",
-         "hist_quantile")
+         "hist_quantile", "rate_window", "burn_rate")
 
 _OPS = {
     "<": lambda a, b: a < b,
@@ -59,7 +80,13 @@ _OPS = {
 def default_rules(*, commit_p99_ceiling_s: float = 0.5,
                   leaderless_evals: int = 5,
                   election_storm_rate: int = 3,
-                  log_headroom_floor: int = 16) -> List[dict]:
+                  log_headroom_floor: int = 16,
+                  commit_slo_bound_s: float = 0.25,
+                  read_slo_bound_us: float = 5000.0,
+                  slo_objective: float = 0.99,
+                  burn_fast_s: float = 30.0,
+                  burn_slow_s: float = 300.0,
+                  burn_threshold: float = 6.0) -> List[dict]:
     """The stock SLO rule set: digest mismatch pages immediately (a
     correctness violation, not a performance blip); sustained
     leaderlessness pages; commit-latency p99 above the ceiling and a
@@ -85,6 +112,18 @@ def default_rules(*, commit_p99_ceiling_s: float = 0.5,
     quarantined replica and escalated: automated repair gave up, an
     operator must act. Silent on clusters that never escalate (the
     metric does not exist until the first escalation).
+
+    Two ``burn_rate`` rules page on the serving SLOs — the
+    window-domain replacement for eyeballing instantaneous p99s
+    (which the ``commit_latency_p99`` warn rule still does, for
+    continuity): ``commit_latency_slo_burn`` pages when more than
+    ``burn_threshold`` times the error budget (``1 - slo_objective``
+    of commits slower than ``commit_slo_bound_s``) burns in BOTH the
+    fast and slow windows; ``read_latency_slo_burn`` is the same over
+    ``read_latency_us`` (the PR 10 read path). Both bounds sit on
+    bucket boundaries of their ladders by construction. Silent
+    without an attached ``series=`` store (``AlertEngine(series=)``)
+    — the drivers always attach one.
     """
     return [
         dict(name="digest_divergence", severity=PAGE,
@@ -106,19 +145,23 @@ def default_rules(*, commit_p99_ceiling_s: float = 0.5,
              value=log_headroom_floor, agg="min"),
         dict(name="repair_failed", severity=PAGE,
              kind="counter_nonzero", metric="repair_escalated_total"),
+        dict(name="commit_latency_slo_burn", severity=PAGE,
+             kind="burn_rate", metric="commit_latency_seconds",
+             bound=commit_slo_bound_s, objective=slo_objective,
+             fast_window_s=burn_fast_s, slow_window_s=burn_slow_s,
+             burn_threshold=burn_threshold, for_evals=2),
+        dict(name="read_latency_slo_burn", severity=PAGE,
+             kind="burn_rate", metric="read_latency_us",
+             bound=read_slo_bound_us, objective=slo_objective,
+             fast_window_s=burn_fast_s, slow_window_s=burn_slow_s,
+             burn_threshold=burn_threshold, for_evals=2),
     ]
 
 
 def _split_key(key: str) -> Tuple[str, Dict[str, str]]:
-    base, sep, rest = key.partition("{")
-    if not sep:
-        return base, {}
-    pairs = {}
-    for part in rest.rstrip("}").split(","):
-        if part:
-            k, _, v = part.partition("=")
-            pairs[k] = v
-    return base, pairs
+    from rdma_paxos_tpu.obs.metrics import parse_key
+    base, pairs = parse_key(key)
+    return base, dict(pairs)
 
 
 def _match(section: dict, metric: str,
@@ -161,9 +204,14 @@ class AlertEngine:
     with per-rule hysteresis and firing-state export."""
 
     def __init__(self, registry, rules: Optional[Sequence[dict]] = None,
-                 *, trace=None):
+                 *, trace=None, series=None):
         self.registry = registry
         self.trace = trace
+        # the TimeSeriesStore the window-domain kinds (rate_window /
+        # burn_rate) evaluate against; without one those rules are
+        # silent — never an error (same contract as telemetry rules
+        # on telemetry-off clusters)
+        self.series = series
         self.rules = [dict(r) for r in (rules if rules is not None
                                         else default_rules())]
         seen = set()
@@ -196,6 +244,34 @@ class AlertEngine:
                 if r.get("op", ">") not in _OPS:
                     raise ValueError(
                         f"rule {r['name']!r}: bad op {r.get('op')!r}")
+            elif kind == "rate_window":
+                if "threshold" not in r:
+                    raise ValueError(
+                        f"rule {r['name']!r}: rate_window needs a "
+                        "threshold")
+                if not (r.get("window_s") or r.get("window_steps")):
+                    raise ValueError(
+                        f"rule {r['name']!r}: rate_window needs "
+                        "window_s or window_steps")
+                if r.get("op", ">") not in _OPS:
+                    raise ValueError(
+                        f"rule {r['name']!r}: bad op {r.get('op')!r}")
+            elif kind == "burn_rate":
+                for field in ("bound", "objective", "fast_window_s",
+                              "slow_window_s"):
+                    if field not in r:
+                        raise ValueError(
+                            f"rule {r['name']!r}: burn_rate needs "
+                            f"{field}")
+                if not 0.0 < float(r["objective"]) < 1.0:
+                    raise ValueError(
+                        f"rule {r['name']!r}: objective must be in "
+                        "(0, 1)")
+                if float(r["slow_window_s"]) <= float(
+                        r["fast_window_s"]):
+                    raise ValueError(
+                        f"rule {r['name']!r}: slow_window_s must "
+                        "exceed fast_window_s")
         self._lock = threading.Lock()
         # alert→action hooks: fn(name, severity) called on each FIRE
         # transition (outside the engine lock; exceptions are swallowed
@@ -206,7 +282,8 @@ class AlertEngine:
         self._st: Dict[str, dict] = {
             r["name"]: dict(severity=r.get("severity", WARN),
                             firing=False, pending=0, value=None,
-                            since_eval=None, fired_count=0)
+                            since_eval=None, since=None,
+                            duration_s=None, fired_count=0)
             for r in self.rules}
         self._prev_counter: Dict[str, float] = {}
         self.evals = 0
@@ -243,13 +320,88 @@ class AlertEngine:
                 return None, False
             return value, _OPS[rule.get("op", ">")](value,
                                                     rule["threshold"])
+        if kind == "rate_window":
+            rate = self._window_rate(rule)
+            if rate is None:
+                return None, False
+            return rate, _OPS[rule.get("op", ">")](rate,
+                                                   rule["threshold"])
+        if kind == "burn_rate":
+            fast = self._burn(rule, float(rule["fast_window_s"]))
+            slow = self._burn(rule, float(rule["slow_window_s"]))
+            if fast is None or slow is None:
+                return fast, False
+            thresh = float(rule.get("burn_threshold", 1.0))
+            return fast, fast > thresh and slow > thresh
         raise AssertionError(kind)
 
-    def evaluate(self) -> Dict[str, List[str]]:
+    # ---------------- window-domain evaluation (series store) ----------
+
+    def _window_rate(self, rule: dict) -> Optional[float]:
+        """Summed per-second rate of every matching counter series
+        over the rule's trailing window; None until the store holds
+        enough history."""
+        if self.series is None:
+            return None
+        kw = (dict(wall_s=float(rule["window_s"]))
+              if rule.get("window_s")
+              else dict(steps=int(rule["window_steps"])))
+        total, found = 0.0, False
+        for key in self.series.match(rule["metric"],
+                                     rule.get("labels")):
+            r = self.series.window_rate(key, **kw)
+            if r is not None:
+                total += r
+                found = True
+        return total if found else None
+
+    def _burn(self, rule: dict, window_s: float) -> Optional[float]:
+        """SLO burn rate over one window: the fraction of histogram
+        observations ABOVE ``bound`` across all matching label sets,
+        divided by the error budget ``1 - objective``. The bound must
+        sit on a bucket boundary; when it doesn't exactly (float
+        drift), the largest retained bound <= it is used — which can
+        only OVERcount the bad fraction (conservative paging)."""
+        if self.series is None:
+            return None
+        metric, labels = rule["metric"], rule.get("labels")
+        total = good = 0.0
+        saw_total = saw_good = False
+        for key in self.series.match(metric, labels, sub="count"):
+            d = self.series.window_delta(key, wall_s=window_s)
+            if d is not None:
+                total += d
+                saw_total = True
+                # the parent key ("name{labels}") indexes the le
+                # ladder this histogram retained; repr(float) is
+                # stable through the store's float round-trip, so
+                # rebuilding the sub-key from the parsed bound hits
+                # the exact retained series
+                parent = key.rsplit("|", 1)[0]
+                bounds = [b for b in self.series.le_bounds(parent)
+                          if b <= float(rule["bound"]) + 1e-12]
+                if bounds:
+                    g = self.series.window_delta(
+                        f"{parent}|le|{bounds[-1]!r}",
+                        wall_s=window_s)
+                    if g is not None:
+                        good += g
+                        saw_good = True
+        if not saw_total or total <= 0.0:
+            return None
+        bad_frac = max(0.0, (total - (good if saw_good else 0.0))
+                       / total)
+        return bad_frac / max(1e-12, 1.0 - float(rule["objective"]))
+
+    def evaluate(self,
+                 snap: Optional[dict] = None) -> Dict[str, List[str]]:
         """One evaluation pass; returns the transitions
         ``{"fired": [...], "resolved": [...]}``. Firing gauges
-        (``alert_firing{alert=name}``) are refreshed every pass."""
-        snap = self.registry.snapshot()
+        (``alert_firing{alert=name}``) are refreshed every pass.
+        ``snap`` lets the caller share one registry snapshot with the
+        series-store sampling it just did (the drivers' cadence)."""
+        if snap is None:
+            snap = self.registry.snapshot()
         fired: List[str] = []
         resolved: List[str] = []
         with self._lock:
@@ -265,6 +417,7 @@ class AlertEngine:
                             >= int(rule.get("for_evals", 1))):
                         st["firing"] = True
                         st["since_eval"] = self.evals
+                        st["since"] = time.time()
                         st["fired_count"] += 1
                         fired.append(rule["name"])
                 else:
@@ -272,6 +425,7 @@ class AlertEngine:
                     if st["firing"]:
                         st["firing"] = False
                         st["since_eval"] = None
+                        st["since"] = None
                         resolved.append(rule["name"])
                 self.registry.set("alert_firing",
                                   1 if st["firing"] else 0,
@@ -309,6 +463,17 @@ class AlertEngine:
                     and (severity is None or st["severity"] == severity)]
 
     def state(self) -> dict:
-        """Per-rule firing state for health snapshots (plain data)."""
+        """Per-rule firing state for health snapshots (plain data).
+        Firing rules carry ``since`` (wall time the fire transition
+        happened) and a live ``duration_s`` — the age the console
+        renders next to each firing alert."""
+        now = time.time()
         with self._lock:
-            return {n: dict(st) for n, st in self._st.items()}
+            out = {}
+            for n, st in self._st.items():
+                d = dict(st)
+                d["duration_s"] = (round(now - d["since"], 3)
+                                   if d["firing"] and d["since"]
+                                   is not None else None)
+                out[n] = d
+            return out

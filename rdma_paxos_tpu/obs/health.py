@@ -29,11 +29,53 @@ HEALTH_FIELDS = (
     "ts",                    # time.time() at snapshot
 )
 
+# the CLUSTER-level schema (``ClusterDriver.health()`` /
+# ``ShardedClusterDriver.health()``): every field the subsystems of
+# PRs 5-10 now emit — alert firing state, audit summary + artifact
+# path, repair pipeline status, lease/read-path status. Values may be
+# None (e.g. ``audit`` on an unaudited cluster) but the KEYS must be
+# present, so aggregators (the fleet console, the bundle assembler)
+# never have to feature-probe a health document.
+CLUSTER_HEALTH_FIELDS = (
+    "n_replicas", "replicas",
+    "alerts",                # AlertEngine.state() (since/duration_s)
+    "audit",                 # AuditLedger.summary() or None
+    "audit_artifact",        # last dumped artifact path or None
+    "repair",                # RepairController.status() or None
+    "leases",                # LeaseManager.status() or None
+    "reads",                 # ReadHub.status() or None
+    "ts",
+)
+
 
 def validate(snap: dict) -> List[str]:
     """-> the list of required fields missing from ``snap`` (empty when
     the snapshot conforms)."""
     return [f for f in HEALTH_FIELDS if f not in snap]
+
+
+def validate_cluster(snap: dict) -> List[str]:
+    """Cluster-health schema check: the :data:`CLUSTER_HEALTH_FIELDS`
+    keys plus a leader view — ``leader`` (single-group) or
+    ``leaders`` (one per group, sharded). Returns the missing field
+    names (empty when the document conforms)."""
+    missing = [f for f in CLUSTER_HEALTH_FIELDS if f not in snap]
+    if "leader" not in snap and "leaders" not in snap:
+        missing.append("leader|leaders")
+    return missing
+
+
+def make_cluster_snapshot(**fields) -> dict:
+    """Stamp cluster-level health ``fields`` with the same
+    schema/clock headers :func:`make_snapshot` gives per-replica
+    snapshots (wall + monotonic + the shared anchor pair), so a saved
+    ``health()`` document merges onto the fleet timebase like every
+    other dump."""
+    from rdma_paxos_tpu.obs.clock import anchor
+    snap = dict(schema=2, ts=time.time(),
+                ts_monotonic=time.monotonic(), anchor=anchor())
+    snap.update(fields)
+    return snap
 
 
 def make_snapshot(**fields) -> dict:
@@ -84,6 +126,20 @@ class HealthReporter:
             return False
         self.write(snaps)
         return True
+
+    def cluster_path(self) -> str:
+        return os.path.join(self.workdir, "cluster.health.json")
+
+    def write_cluster(self, doc: dict) -> None:
+        """Atomic write of the CLUSTER-level health document
+        (``make_cluster_snapshot`` shape) next to the per-replica
+        files — the file-based fleet console and the postmortem
+        bundle's alert-state source read it."""
+        path = self.cluster_path()
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=2)
+        os.replace(tmp, path)
 
     def read(self, replica: int) -> Optional[dict]:
         try:

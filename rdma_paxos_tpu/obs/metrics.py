@@ -34,7 +34,7 @@ import bisect
 import json
 import os
 import threading
-from typing import Dict, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 # Default bucket ladders. Latency buckets span the p99<50µs device
 # frontier (BASELINE.md) up to election-timeout scale; batch buckets
@@ -60,6 +60,21 @@ def _render(key: Tuple[str, Tuple[Tuple[str, str], ...]]) -> str:
     if not labels:
         return name
     return name + "{" + ",".join(f"{k}={v}" for k, v in labels) + "}"
+
+
+def parse_key(key: str) -> Tuple[str, List[Tuple[str, str]]]:
+    """Inverse of the rendered-key grammar: ``"name{k=v,...}"`` ->
+    ``(name, [(k, v), ...])``. The ONE parser every consumer of
+    rendered keys shares (alert matching, series sub-keys, Prometheus
+    rendering) — the grammar lives here, next to :func:`_render`."""
+    base, sep, rest = key.partition("{")
+    pairs: List[Tuple[str, str]] = []
+    if sep:
+        for part in rest.rstrip("}").split(","):
+            if part:
+                k, _, v = part.partition("=")
+                pairs.append((k, v))
+    return base, pairs
 
 
 class _Hist:

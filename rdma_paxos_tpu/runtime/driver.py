@@ -36,7 +36,8 @@ from rdma_paxos_tpu.consensus.snapshot import (
 from rdma_paxos_tpu.consensus.state import ConfigState, Role
 from rdma_paxos_tpu.obs import Observability, trace as obs_trace
 from rdma_paxos_tpu.obs.alerts import AlertEngine, default_rules
-from rdma_paxos_tpu.obs.health import HealthReporter, make_snapshot
+from rdma_paxos_tpu.obs.health import (
+    HealthReporter, make_cluster_snapshot, make_snapshot)
 from rdma_paxos_tpu.obs.metrics import BATCH_BUCKETS, LATENCY_BUCKETS_S
 from rdma_paxos_tpu.obs.spans import StepPhaseProfiler
 from rdma_paxos_tpu.proxy.proxy import (
@@ -117,7 +118,9 @@ class ClusterDriver:
                  repair: bool = False,
                  repair_opts: Optional[Dict] = None,
                  leases: bool = True,
-                 lease_opts: Optional[Dict] = None):
+                 lease_opts: Optional[Dict] = None,
+                 series_capacity: int = 1280,
+                 metrics_port: Optional[int] = None):
         self.cfg = cfg
         self.sync_period = sync_period
         self._workdir = workdir
@@ -192,6 +195,24 @@ class ClusterDriver:
         if leases:
             from rdma_paxos_tpu.runtime import reads as _reads
             _reads.attach(self.cluster, **(lease_opts or {}))
+        # time-series retention (obs/series.py): the registry sampled
+        # into bounded per-series rings on the alert cadence — the
+        # substrate the window-domain rules (rate_window / burn_rate)
+        # and the /series endpoint read. With a workdir the samples
+        # persist as append-only JSONL (cross-host merge = file
+        # concat). Host bookkeeping only: no compiled program or
+        # STEP_CACHE key changes (tests/test_ops_plane.py pins it).
+        # Capacity must cover the LONGEST rule window at this cadence
+        # (default 1280 x 0.25 s = 320 s > the 300 s slow burn
+        # window) — a shorter ring saturates early and the slow
+        # window degrades to full-retention, weakening the
+        # multi-window transient suppression.
+        from rdma_paxos_tpu.obs.series import TimeSeriesStore
+        self.series = TimeSeriesStore(
+            capacity=series_capacity,
+            path=(os.path.join(workdir, "series.jsonl")
+                  if workdir else None),
+            source="driver")
         # SLO alert rules (obs/alerts.py) evaluated on a cadence from
         # the poll loop; firing state rides health snapshots and the
         # alert_firing{alert=...} gauges
@@ -199,9 +220,11 @@ class ClusterDriver:
             self.obs.metrics,
             rules=(alert_rules if alert_rules is not None
                    else default_rules()),
-            trace=self.obs.trace)
+            trace=self.obs.trace, series=self.series)
         self._alert_period = alert_period
         self._alert_last = float("-inf")
+        self.exporter = None
+        self._metrics_port = metrics_port
         self.audit_artifact: Optional[str] = None
         # self-healing (runtime/repair.py): repair=True closes the
         # audit loop — DIVERGENCE → quarantine → digest-verified
@@ -314,6 +337,13 @@ class ClusterDriver:
         self._pl_pending = 0        # dispatched, not yet post-stepped
         self._pl_queue: _queue.Queue = _queue.Queue()
         self._rb_thread: Optional[threading.Thread] = None
+        # opt-in ops exporter (obs/export.py): /metrics /healthz
+        # /series /alerts on a localhost port (0 = ephemeral) — runs
+        # beside the readback thread, never on the dispatch path.
+        # Attached LAST: a scrape may land the instant the socket
+        # binds, and health() touches everything above.
+        if self._metrics_port is not None:
+            self.serve_metrics(self._metrics_port)
 
     def _make_cluster(self, cfg, n_replicas, group_size, mode, fanout,
                       audit, telemetry):
@@ -754,7 +784,15 @@ class ClusterDriver:
         self._poll_profile()
         if self._health is not None and self._health.due():
             try:
-                self._health.write(self._health_snapshots(res))
+                # ONE health() pass feeds both files: the per-replica
+                # snapshots and the cluster-level document (leader
+                # view, lease/read status, repair state, ALERT firing
+                # state — the file-based console's and the postmortem
+                # bundle's cluster source)
+                h = self.health()
+                self._health.write({rep["replica"]: rep
+                                    for rep in h["replicas"]})
+                self._health.write_cluster(h)
             except OSError:
                 # observability I/O must never kill the data path: a
                 # vanished workdir / full disk costs the snapshot, not
@@ -798,8 +836,17 @@ class ClusterDriver:
         audited cluster dumps the audit artifact (ledger + flight ring
         + obs dumps) for post-mortem, and — with ``profile_on_page``
         set — starts ONE bounded device-profiler capture so the pages'
-        root cause is inspectable on the device timeline."""
-        out = self.alerts.evaluate()
+        root cause is inspectable on the device timeline.
+
+        The series store samples FIRST, from the same registry
+        snapshot the rules then evaluate — so the window-domain rules
+        (rate_window / burn_rate) always see the freshest point and
+        the retention cadence IS the alert cadence."""
+        snap = self.obs.metrics.snapshot()
+        if self.series is not None:
+            self.series.sample(snap,
+                               step=int(self.cluster.step_index))
+        out = self.alerts.evaluate(snap=snap)
         pages = [n for n in out["fired"]
                  if self.alerts.severity(n) == "page"]
         if pages and (self.cluster.auditor is not None
@@ -879,12 +926,14 @@ class ClusterDriver:
 
     def health(self) -> Dict:
         """Aggregated cluster health (live — not from the files): the
-        per-replica snapshots plus the cluster-level view. Safe to call
-        from any thread; uses the last completed step's outputs."""
+        per-replica snapshots plus the cluster-level view, conforming
+        to ``obs.health.CLUSTER_HEALTH_FIELDS`` (validate with
+        ``obs.health.validate_cluster``). Safe to call from any
+        thread; uses the last completed step's outputs."""
         res = self.cluster.last
         replicas = (self._health_snapshots(res) if res is not None
                     else {})
-        return dict(
+        return make_cluster_snapshot(
             leader=self.leader(),
             n_replicas=self.R,
             replicas=[replicas[r] for r in sorted(replicas)],
@@ -902,8 +951,28 @@ class ClusterDriver:
                     if self.cluster.leases is not None else None),
             reads=(self.cluster.reads.status()
                    if self.cluster.reads is not None else None),
-            ts=time.time(),
         )
+
+    # ------------------------------------------------------------------
+    # the ops exporter (obs/export.py) — /metrics /healthz /series
+    # /alerts beside the readback thread, never on the dispatch path
+    # ------------------------------------------------------------------
+
+    def serve_metrics(self, port: int = 0):
+        """Start (or return) the opt-in localhost ops exporter:
+        ``/metrics`` (Prometheus text), ``/metrics.json``,
+        ``/healthz`` (503 on a dead poll loop), ``/series``,
+        ``/alerts``. ``port=0`` binds an ephemeral port — read it
+        back from ``driver.exporter.port``. Pure host-side serving of
+        already-thread-safe read surfaces; programs and STEP_CACHE
+        keys are untouched (pinned by test)."""
+        if self.exporter is None:
+            from rdma_paxos_tpu.obs.export import OpsExporter
+            self.exporter = OpsExporter(
+                registry=self.obs.metrics, health_fn=self.health,
+                alerts=self.alerts, series=self.series,
+                port=port).start()
+        return self.exporter
 
     # ------------------------------------------------------------------
     # failure detection + eviction (push-detection analog: WC failures
@@ -1629,6 +1698,13 @@ class ClusterDriver:
             return
         self._stop.set()
         self._wake.set()
+        # the ops exporter and series log are independent of the poll
+        # thread — close them first so a wedged loop still leaves a
+        # flushed series.jsonl and a closed port behind
+        if self.exporter is not None:
+            self.exporter.close()
+        if self.series is not None:
+            self.series.close()
         with self._pl_cv:
             self._pl_cv.notify_all()
         if self._thread is not None:

@@ -260,8 +260,11 @@ class HostReplicaDriver:
                             "audit_term") if self._audit else ())
         for k in keys:
             arr = getattr(out, k)
+            # a 1-wide replica axis (single-host world) shards as
+            # slice(None), whose .start is None — that shard IS
+            # replica 0's
             local = [s for s in arr.addressable_shards
-                     if s.index[0].start == self.me]
+                     if (s.index[0].start or 0) == self.me]
             res[k] = np.asarray(local[0].data[0]) if local else None
         return res
 
@@ -333,12 +336,12 @@ class HostReplicaDriver:
         for k in OUT_KEYS:
             arr = getattr(outs, k)            # [K, R, ...]
             local = [s for s in arr.addressable_shards
-                     if s.index[1].start == self.me]
+                     if (s.index[1].start or 0) == self.me]
             res[k] = (np.asarray(local[0].data[-1, 0])
                       if local else None)
         if res["accepted"] is not None:
             acc = [s for s in outs.accepted.addressable_shards
-                   if s.index[1].start == self.me]
+                   if (s.index[1].start or 0) == self.me]
             res["accepted"] = np.asarray(acc[0].data[:, 0]).sum()
         if self._audit:
             # audit windows for EVERY fused step (not just the last) —
@@ -349,7 +352,7 @@ class HostReplicaDriver:
                       "commit"):
                 arr = getattr(outs, k)          # [K, R, ...]
                 local = [s for s in arr.addressable_shards
-                         if s.index[1].start == self.me]
+                         if (s.index[1].start or 0) == self.me]
                 res["audit_commit" if k == "commit" else k] = (
                     np.asarray(local[0].data[:, 0]) if local else None)
         return res
@@ -374,7 +377,7 @@ class HostReplicaDriver:
 
         def local(arr):
             sh = [s for s in arr.addressable_shards
-                  if s.index[0].start == self.me]
+                  if (s.index[0].start or 0) == self.me]
             return np.asarray(sh[0].data[0])
 
         out = {"log_buf": local(self.state.log.buf)}
@@ -389,7 +392,7 @@ class HostReplicaDriver:
         replica's log. Host-local (no collective): call freely, on any
         host, only when needed."""
         sh = [s for s in self.state.log.buf.addressable_shards
-              if s.index[0].start == self.me][0]
+              if (s.index[0].start or 0) == self.me][0]
         wd, wm = self._local_fetch(sh.data[0],
                                    jnp.asarray(start, jnp.int32))
         return np.asarray(wd), np.asarray(wm)

@@ -154,14 +154,43 @@ class NodeDaemon:
             self._audit_path = None
         self._audit_write_period = 5.0
         self._audit_last_write = float("-inf")
+        # ops plane (the per-host half of the fleet console's view):
+        # time-series retention sampled on the alert cadence —
+        # persisted as replica<me>.series.jsonl, so merging N hosts'
+        # series is a file concat — feeding the window-domain SLO
+        # rules (rate_window / burn_rate), plus the per-host health
+        # snapshot file the console merges across hosts
+        from rdma_paxos_tpu.obs.health import HealthReporter
+        from rdma_paxos_tpu.obs.series import TimeSeriesStore
+        self.series = TimeSeriesStore(
+            path=os.path.join(workdir,
+                              f"replica{self.me}.series.jsonl"),
+            source=f"replica{self.me}")
+        self._health = HealthReporter(workdir, period=1.0)
         # SLO alert rules over the process-global registry, evaluated
         # on a cadence from the lock-step loop (obs/alerts.py)
         from rdma_paxos_tpu.obs.alerts import AlertEngine, default_rules
         self.alerts = AlertEngine(self.obs.metrics,
                                   rules=default_rules(),
-                                  trace=self.obs.trace)
+                                  trace=self.obs.trace,
+                                  series=self.series)
         self._alert_period = 1.0
         self._alert_last = float("-inf")
+        self.iterations = 0       # the daemon's step-domain clock for
+                                  # series points (one per iterate())
+        # RP_METRICS_PORT: opt-in ops exporter (obs/export.py) —
+        # /metrics /healthz /series /alerts on localhost; "0" binds
+        # an ephemeral port (read it back from daemon.exporter.port).
+        # Host-side only — the exporter never joins the collective
+        # schedule, so hosts may disagree on it freely.
+        self.exporter = None
+        port = os.environ.get("RP_METRICS_PORT")
+        if port is not None and port != "":
+            from rdma_paxos_tpu.obs.export import OpsExporter
+            self.exporter = OpsExporter(
+                registry=self.obs.metrics, health_fn=self.health,
+                alerts=self.alerts, series=self.series,
+                port=int(port)).start()
         self.last: Optional[Dict] = None
         self._rebase_warned = False
         # consecutive post-threshold iterations with the gathered
@@ -569,11 +598,24 @@ class NodeDaemon:
         with self._lock:
             self.obs.metrics.set("inflight_waiters", len(self.inflight),
                                  replica=self.me)
+        self.iterations += 1
+        self.last = res      # before the cadence block: health()
+                             # must read THIS iteration's outputs
         import time as _tmono
         now = _tmono.monotonic()
         if now - self._alert_last >= self._alert_period:
             self._alert_last = now
-            self.alerts.evaluate()
+            # series sampling shares the snapshot with the rule pass
+            # (the drivers' cadence contract), then the per-host
+            # health file refreshes — the surface the fleet console
+            # and the elastic supervisor watch from outside
+            snap = self.obs.metrics.snapshot()
+            self.series.sample(snap, step=self.iterations)
+            self.alerts.evaluate(snap=snap)
+            try:
+                self._health.write({self.me: self.health()})
+            except OSError:
+                pass     # observability I/O never kills the loop
         if (self._audit_path is not None and self.auditor is not None
                 and now - self._audit_last_write
                 >= self._audit_write_period):
@@ -582,7 +624,6 @@ class NodeDaemon:
                 self.auditor.write_json(self._audit_path)
             except OSError:
                 pass     # evidence I/O must never kill the data path
-        self.last = res
         return res
 
     def _ingest_audit(self, res: Dict) -> None:
@@ -607,6 +648,42 @@ class NodeDaemon:
             off = start - (commit - W)
             led.record_window(self.me, start + reb, d[off:off + n],
                               t[off:off + n], commit + reb)
+
+    def health(self) -> Dict:
+        """THIS host's replica health snapshot (the obs.health
+        per-replica schema plus daemon extras) — written to
+        ``replica<me>.health.json`` on the reporter cadence, served
+        at ``/healthz`` when RP_METRICS_PORT is set, and merged
+        across hosts by the fleet console (N daemon files = one
+        cluster seen from N sides)."""
+        from rdma_paxos_tpu.obs.health import make_snapshot
+        res = getattr(self, "last", None)
+        with self._lock:
+            inflight = len(self.inflight)
+        return make_snapshot(
+            replica=self.me,
+            host_id=self.host_id,
+            gen=self.gen,
+            role=(int(res["role"]) if res is not None else -1),
+            term=(int(res["term"]) if res is not None else 0),
+            leader_id=(int(res["leader_id"]) if res is not None
+                       else -1),
+            commit=(int(res["commit"]) if res is not None else 0),
+            apply=self.applied,
+            end=(int(res["end"]) if res is not None else 0),
+            head=(int(res["head"]) if res is not None else 0),
+            log_headroom=(self.cfg.rebase_threshold
+                          - (int(res["end"]) if res is not None
+                             else 0)),
+            inflight=inflight,
+            app_dirty=self.app_dirty,
+            needs_recovery=self.needs_recovery,
+            rebase_stalled=self.rebase_stalled,
+            store=self.store.stats(),
+            alerts=self.alerts.state(),
+            audit=(self.auditor.summary()
+                   if self.auditor is not None else None),
+        )
 
     def bootstrap_from_store(self) -> None:
         """Rebuild a FRESH local app instance by replaying the stable
@@ -686,6 +763,15 @@ class NodeDaemon:
                 self.auditor.write_json(self._audit_path)
             except OSError:
                 pass
+        if self.exporter is not None:
+            self.exporter.close()
+        try:
+            # final health snapshot — the post-exit state the console
+            # (and a postmortem bundle) reads after the process is gone
+            self._health.write({self.me: self.health()})
+        except OSError:
+            pass
+        self.series.close()
         self.proxy.close()
         if self.replay:
             self.replay.close()

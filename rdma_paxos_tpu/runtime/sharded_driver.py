@@ -550,7 +550,10 @@ class ShardedClusterDriver(ClusterDriver):
         self._poll_profile()
         if self._health is not None and self._health.due():
             try:
-                self._health.write(self._health_snapshots(res))
+                h = self.health()
+                self._health.write({rep["replica"]: rep
+                                    for rep in h["replicas"]})
+                self._health.write_cluster(h)
             except OSError:
                 pass    # evidence I/O never kills the data path
 
@@ -569,7 +572,13 @@ class ShardedClusterDriver(ClusterDriver):
         return snaps
 
     def health(self) -> Dict:
+        """Sharded cluster health, conforming to the same
+        ``obs.health.CLUSTER_HEALTH_FIELDS`` schema as the
+        single-group driver's (``leaders`` stands in for
+        ``leader``)."""
+        from rdma_paxos_tpu.obs.health import make_cluster_snapshot
         h = self.cluster.health()
+        h.pop("schema", None)     # the wrapper stamps the schema
         h.update(
             leaders=self.leaders(),
             all_groups_led=self.leader() >= 0,
@@ -582,9 +591,8 @@ class ShardedClusterDriver(ClusterDriver):
             repair=(self.repair.status()
                     if self.repair is not None else None),
             reads=(self.cluster.reads.status()
-                   if self.cluster.reads is not None else None),
-            ts=time.time())
-        return h
+                   if self.cluster.reads is not None else None))
+        return make_cluster_snapshot(**h)
 
     def read(self, fn=None, *, key=None, group: Optional[int] = None,
              replica: Optional[int] = None, timeout: float = 30.0):

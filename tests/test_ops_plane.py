@@ -1,0 +1,750 @@
+"""The fleet ops plane (obs/series.py, obs/export.py,
+obs/console.py + the alerts window-domain kinds and driver wiring).
+
+Covers the PR 12 acceptance surface:
+
+* TimeSeriesStore sampling semantics (counters→windowed rates with
+  exact cumulative deltas, gauges→last, histograms→quantile/CDF
+  sub-series), bounded retention, and append-only JSONL whose
+  cross-host merge is a file concat;
+* the Prometheus text renderer (cumulative ``le=`` buckets) and the
+  ops HTTP exporter's five endpoints on an ephemeral port;
+* the window-domain rule kinds: ``rate_window`` and multi-window
+  ``burn_rate`` — a scripted latency regression fires the DEFAULT
+  commit-latency SLO burn rule and resolves after recovery;
+* per-alert ``since``/``duration_s`` and the
+  ``alert_firing{alert=}`` gauge dropping to 0 on resolve;
+* the cluster health schema (``validate_cluster``) round-tripping
+  through JSON for BOTH drivers — leases/reads/repair/alerts/
+  audit_artifact keys always present;
+* live-scrape e2e: a driver serves /metrics + /healthz, a
+  single-process NodeDaemon (subprocess) serves the same via
+  RP_METRICS_PORT, and ``obs.console --once`` renders a fleet table
+  merged from ≥2 sources;
+* postmortem bundles: assemble from a workdir, ``--verify`` exits 0,
+  a tampered or section-missing bundle exits 1;
+* the cache-key guard (exporter+series attached vs detached →
+  bit-identical step outputs, ZERO new STEP_CACHE keys) and the
+  static jit-safety scan extended to the three new modules.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from rdma_paxos_tpu.config import LogConfig, TimeoutConfig
+from rdma_paxos_tpu.consensus.state import Role
+from rdma_paxos_tpu.obs import console as console_mod
+from rdma_paxos_tpu.obs.alerts import AlertEngine, default_rules
+from rdma_paxos_tpu.obs.export import OpsExporter, render_prometheus
+from rdma_paxos_tpu.obs.health import (
+    validate, validate_cluster)
+from rdma_paxos_tpu.obs.metrics import (
+    LATENCY_BUCKETS_S, MetricsRegistry)
+from rdma_paxos_tpu.obs.series import (
+    TimeSeriesStore, merge_docs, read_jsonl, split_series_key)
+from rdma_paxos_tpu.runtime.driver import ClusterDriver
+from rdma_paxos_tpu.runtime.sim import STEP_CACHE
+
+CFG = LogConfig(n_slots=64, slot_bytes=32, window_slots=16,
+                batch_slots=8)
+TO = TimeoutConfig(elec_timeout_low=1e9, elec_timeout_high=2e9)
+
+
+def _get(url, timeout=10.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.read()
+
+
+def _get_json(url, timeout=10.0):
+    return json.loads(_get(url, timeout)[1])
+
+
+# ---------------------------------------------------------------------------
+# TimeSeriesStore
+# ---------------------------------------------------------------------------
+
+def test_series_counter_rates_and_deltas():
+    reg = MetricsRegistry()
+    store = TimeSeriesStore(capacity=16)
+    w = 100.0
+    for i in range(5):
+        reg.inc("ops_total", 10, replica=0)
+        store.sample(reg.snapshot(), step=i, wall=w + i * 2.0)
+    pts = store.points("ops_total{replica=0}")
+    assert len(pts) == 5
+    # first point establishes the baseline (rate 0); later points are
+    # the windowed rate: 10 ops / 2 s = 5/s
+    assert pts[0][2] == 0.0
+    assert all(p[2] == pytest.approx(5.0) for p in pts[1:])
+    # cumulative deltas over the trailing window are exact
+    assert store.window_delta("ops_total{replica=0}",
+                              wall_s=4.0) == pytest.approx(20.0)
+    assert store.window_rate("ops_total{replica=0}",
+                             wall_s=4.0) == pytest.approx(5.0)
+    # step-domain windows work too
+    assert store.window_delta("ops_total{replica=0}",
+                              steps=2) == pytest.approx(20.0)
+
+
+def test_series_gauge_last_and_hist_sub_series():
+    reg = MetricsRegistry()
+    store = TimeSeriesStore(capacity=16)
+    reg.set("cluster_leader", 2)
+    for _ in range(100):
+        reg.observe("lat", 0.01, buckets=LATENCY_BUCKETS_S)
+    store.sample(reg.snapshot(), step=0, wall=1.0)
+    assert store.latest("cluster_leader") == 2
+    # histogram decomposes into quantile + count/sum + CDF series
+    assert store.latest("lat|p50") == pytest.approx(0.01)
+    assert store.latest("lat|p99") == pytest.approx(0.01)
+    names = store.names()
+    assert "lat|count" in names and "lat|sum" in names
+    assert "lat|le|0.01" in names
+    assert store.le_bounds("lat") == sorted(
+        float(b) for b in LATENCY_BUCKETS_S)
+    base, labels, sub = split_series_key("lat{replica=0}|le|0.01")
+    assert (base, labels, sub) == ("lat", {"replica": "0"},
+                                   "le|0.01")
+
+
+def test_series_bounded_retention():
+    reg = MetricsRegistry()
+    store = TimeSeriesStore(capacity=8)
+    for i in range(50):
+        reg.inc("c")
+        store.sample(reg.snapshot(), step=i, wall=float(i))
+    pts = store.points("c")
+    assert len(pts) == 8                       # ring bounded
+    assert pts[0][0] == 42 and pts[-1][0] == 49   # newest retained
+
+
+def test_series_jsonl_concat_merge(tmp_path):
+    """Cross-host merge is a file concat: two stores' logs
+    concatenated come apart cleanly by src tag."""
+    reg_a, reg_b = MetricsRegistry(), MetricsRegistry()
+    a = TimeSeriesStore(capacity=8, path=str(tmp_path / "a.jsonl"),
+                        source="hostA")
+    b = TimeSeriesStore(capacity=8, path=str(tmp_path / "b.jsonl"),
+                        source="hostB")
+    for i in range(3):
+        reg_a.inc("x", 2)
+        reg_b.set("g", i)
+        a.sample(reg_a.snapshot(), step=i, wall=10.0 + i)
+        b.sample(reg_b.snapshot(), step=i, wall=20.0 + i)
+    a.close()
+    b.close()
+    concat = tmp_path / "fleet.jsonl"
+    concat.write_bytes((tmp_path / "a.jsonl").read_bytes()
+                       + (tmp_path / "b.jsonl").read_bytes())
+    docs = merge_docs(read_jsonl(str(concat)))
+    assert set(docs) == {"hostA", "hostB"}
+    assert docs["hostA"]["anchor"] is not None
+    assert len(docs["hostA"]["series"]["x"]) == 3
+    # counter lines carry [rate, cum]; cum is exact after the merge
+    assert docs["hostA"]["series"]["x"][-1][3] == 6.0
+    assert docs["hostB"]["series"]["g"][-1][2] == 2.0
+
+
+def test_series_window_cold_start_guard():
+    """A window longer than the retained history is UNKNOWN (None)
+    until the ring either spans it or saturates — a short boot
+    history must never masquerade as the slow burn window."""
+    reg = MetricsRegistry()
+    store = TimeSeriesStore(capacity=8)
+    for i in range(3):
+        reg.inc("c", 5)
+        store.sample(reg.snapshot(), step=i, wall=float(i * 2))
+    # 4 s of history cannot answer a 100 s window
+    assert store.window_delta("c", wall_s=100.0) is None
+    assert store.window_rate("c", wall_s=100.0) is None
+    for i in range(3, 9):           # saturate the ring (capacity 8)
+        reg.inc("c", 5)
+        store.sample(reg.snapshot(), step=i, wall=float(i * 2))
+    # saturated: full retention is all we can know — evaluate over it
+    assert store.window_delta("c", wall_s=100.0) == pytest.approx(35.0)
+
+
+def test_series_log_open_failure_never_raises(tmp_path):
+    """Retention I/O must never kill the caller: a missing workdir
+    costs the JSONL log, not the store (in-memory sampling keeps
+    working) — and ClusterDriver construction survives it."""
+    store = TimeSeriesStore(
+        capacity=8, path=str(tmp_path / "no" / "such" / "x.jsonl"))
+    reg = MetricsRegistry()
+    reg.inc("c")
+    assert store.sample(reg.snapshot(), step=0, wall=1.0) == 1
+    assert store.points("c")
+    store.close()
+
+
+def test_series_to_dict_is_json_serializable():
+    reg = MetricsRegistry()
+    store = TimeSeriesStore(capacity=4)
+    reg.inc("c")
+    store.sample(reg.snapshot(), step=1, wall=1.0)
+    doc = json.loads(json.dumps(store.to_dict()))
+    assert doc["kind"] == "series" and doc["samples"] == 1
+    assert "c" in doc["series"]
+
+
+# ---------------------------------------------------------------------------
+# Prometheus rendering + the exporter endpoints
+# ---------------------------------------------------------------------------
+
+def test_render_prometheus_shapes():
+    reg = MetricsRegistry()
+    reg.inc("ops_total", 3, replica=0)
+    reg.set("role", 1, replica=2)
+    for v in (0.01, 0.01, 2.0):
+        reg.observe("lat_seconds", v, buckets=(0.1, 1.0))
+    text = render_prometheus(reg.snapshot())
+    assert "# TYPE ops_total counter" in text
+    assert 'ops_total{replica="0"} 3' in text
+    assert 'role{replica="2"} 1' in text
+    # buckets are CUMULATIVE in the exposition format
+    assert 'lat_seconds_bucket{le="0.1"} 2' in text
+    assert 'lat_seconds_bucket{le="1.0"} 2' in text
+    assert 'lat_seconds_bucket{le="+Inf"} 3' in text
+    assert "lat_seconds_count 3" in text
+
+
+def test_exporter_endpoints_ephemeral_port():
+    reg = MetricsRegistry()
+    reg.inc("c", 7)
+    store = TimeSeriesStore(capacity=8)
+    store.sample(reg.snapshot(), step=0, wall=1.0)
+    eng = AlertEngine(reg, rules=default_rules(), series=store)
+    eng.evaluate()
+    health = {"leader": 0, "loop_error": None}
+    exp = OpsExporter(registry=reg, health_fn=lambda: dict(health),
+                      alerts=eng, series=store, port=0).start()
+    try:
+        assert exp.port > 0
+        st, body = _get(exp.url + "/metrics")
+        assert st == 200 and b"c 7" in body
+        assert _get_json(exp.url + "/metrics.json")["counters"][
+            "c"] == 7
+        st, body = _get(exp.url + "/healthz")
+        assert st == 200 and json.loads(body)["leader"] == 0
+        doc = _get_json(exp.url + "/series")
+        assert doc["samples"] == 1
+        doc = _get_json(exp.url + "/alerts")
+        assert "leaderless" in doc["state"]
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(exp.url + "/nope")
+        assert ei.value.code == 404
+        # a dead poll loop fails the health probe with 503
+        health["loop_error"] = "RuntimeError('boom')"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(exp.url + "/healthz")
+        assert ei.value.code == 503
+        assert json.loads(ei.value.read())["loop_error"]
+    finally:
+        exp.close()
+
+
+# ---------------------------------------------------------------------------
+# window-domain alert kinds
+# ---------------------------------------------------------------------------
+
+def test_rate_window_rule_fires_and_resolves():
+    reg = MetricsRegistry()
+    store = TimeSeriesStore(capacity=64)
+    eng = AlertEngine(
+        reg, rules=[dict(name="hot", severity="warn",
+                         kind="rate_window", metric="errors_total",
+                         window_s=10.0, threshold=5.0)],
+        series=store)
+    w = 0.0
+    for i in range(4):          # quiet: 1/s
+        reg.inc("errors_total", 2)
+        store.sample(reg.snapshot(), step=i, wall=w)
+        w += 2.0
+    assert eng.evaluate() == dict(fired=[], resolved=[])
+    for i in range(4, 10):      # hot: 10/s
+        reg.inc("errors_total", 20)
+        store.sample(reg.snapshot(), step=i, wall=w)
+        w += 2.0
+    out = eng.evaluate()
+    assert out["fired"] == ["hot"]
+    assert eng.state()["hot"]["value"] > 5.0
+    for i in range(10, 22):     # quiet again
+        store.sample(reg.snapshot(), step=i, wall=w)
+        w += 2.0
+    assert "hot" in eng.evaluate()["resolved"]
+
+
+def test_burn_rate_default_rule_fires_and_resolves():
+    """The scripted latency regression of the acceptance criteria:
+    the DEFAULT commit-latency SLO burn rule (bound 0.25 s, 99%
+    objective, 30 s / 300 s windows) fires during a regression and
+    resolves after recovery — through the same sample/evaluate
+    cadence the drivers run."""
+    reg = MetricsRegistry()
+    store = TimeSeriesStore(capacity=256)
+    eng = AlertEngine(reg, rules=default_rules(), series=store)
+    w = 1000.0
+
+    def drive(n, latency, per=20):
+        nonlocal w
+        out = []
+        for i in range(n):
+            for _ in range(per):
+                reg.observe("commit_latency_seconds", latency,
+                            buckets=LATENCY_BUCKETS_S, replica=0)
+            store.sample(reg.snapshot(), step=store.samples, wall=w)
+            w += 5.0
+            out.append(eng.evaluate())
+        return out
+
+    drive(10, 0.01)
+    assert not eng.state()["commit_latency_slo_burn"]["firing"]
+    fired_at = None
+    for i, out in enumerate(drive(70, 2.0)):
+        if "commit_latency_slo_burn" in out["fired"]:
+            fired_at = i
+            break
+    assert fired_at is not None, "regression never fired the burn rule"
+    st = eng.state()["commit_latency_slo_burn"]
+    assert st["firing"] and st["value"] > 6.0
+    resolved = False
+    for out in drive(140, 0.01, per=60):
+        if "commit_latency_slo_burn" in out["resolved"]:
+            resolved = True
+            break
+    assert resolved, "recovery never resolved the burn rule"
+
+
+def test_window_rules_silent_without_series():
+    eng = AlertEngine(MetricsRegistry(), rules=default_rules())
+    out = eng.evaluate()
+    assert out == dict(fired=[], resolved=[])
+    assert eng.state()["commit_latency_slo_burn"]["value"] is None
+
+
+def test_new_rule_kind_validation():
+    reg = MetricsRegistry()
+    with pytest.raises(ValueError, match="rate_window needs"):
+        AlertEngine(reg, rules=[dict(name="x", kind="rate_window",
+                                     metric="m", threshold=1)])
+    with pytest.raises(ValueError, match="burn_rate needs"):
+        AlertEngine(reg, rules=[dict(name="x", kind="burn_rate",
+                                     metric="m", bound=0.1)])
+    with pytest.raises(ValueError, match="objective"):
+        AlertEngine(reg, rules=[dict(
+            name="x", kind="burn_rate", metric="m", bound=0.1,
+            objective=1.5, fast_window_s=1, slow_window_s=10)])
+    with pytest.raises(ValueError, match="slow_window_s"):
+        AlertEngine(reg, rules=[dict(
+            name="x", kind="burn_rate", metric="m", bound=0.1,
+            objective=0.99, fast_window_s=10, slow_window_s=10)])
+
+
+def test_alert_since_duration_and_gauge_drop_on_resolve():
+    """Satellite pin: state() carries since/duration_s while firing,
+    and the alert_firing{alert=} gauge drops to 0 the evaluation the
+    rule resolves — the console trusts the gauge."""
+    reg = MetricsRegistry()
+    eng = AlertEngine(reg, rules=[dict(
+        name="lag", severity="warn", kind="gauge_cmp",
+        metric="depth", op=">", value=10)])
+    reg.set("depth", 50)
+    t0 = time.time()
+    assert eng.evaluate()["fired"] == ["lag"]
+    assert reg.get("alert_firing", alert="lag") == 1
+    st = eng.state()["lag"]
+    assert st["since"] is not None and st["since"] >= t0 - 1
+    assert st["duration_s"] is not None and st["duration_s"] >= 0
+    time.sleep(0.02)
+    assert eng.state()["lag"]["duration_s"] >= 0.02
+    reg.set("depth", 0)
+    assert eng.evaluate()["resolved"] == ["lag"]
+    assert reg.get("alert_firing", alert="lag") == 0
+    st = eng.state()["lag"]
+    assert st["since"] is None and st["duration_s"] is None
+    assert not st["firing"]
+
+
+# ---------------------------------------------------------------------------
+# cluster health schema (satellite 1)
+# ---------------------------------------------------------------------------
+
+def test_cluster_health_schema_roundtrip_single_group():
+    d = ClusterDriver(CFG, 3, timeout_cfg=TO)
+    try:
+        d.cluster.run_until_elected(0)
+        d.cluster.submit(0, b"x")
+        d.step()
+        h = json.loads(json.dumps(d.health()))
+        assert validate_cluster(h) == []
+        assert h["schema"] == 2 and "anchor" in h
+        # the PR 8-10 fields are not just present but live
+        assert h["leases"]["holders"][0] in (0, 1, 2)
+        assert h["reads"]["pending"] == 0
+        assert "commit_latency_slo_burn" in h["alerts"]
+        assert h["repair"] is None and h["audit"] is None
+        for rep in h["replicas"]:
+            assert validate(rep) == []
+    finally:
+        d.stop()
+
+
+def test_cluster_health_schema_roundtrip_sharded():
+    from rdma_paxos_tpu.runtime.sharded_driver import (
+        ShardedClusterDriver)
+    d = ShardedClusterDriver(CFG, 3, 2, timeout_cfg=TO)
+    try:
+        h = json.loads(json.dumps(d.health()))
+        assert validate_cluster(h) == []
+        assert h["leaders"] == [-1, -1]        # nothing elected yet
+        assert len(h["groups"]) == 2
+    finally:
+        d.stop()
+
+
+def test_validate_cluster_detects_missing_fields():
+    assert "leases" in validate_cluster(dict(leader=0, ts=1.0))
+    assert "leader|leaders" in validate_cluster(dict(ts=1.0))
+
+
+# ---------------------------------------------------------------------------
+# driver live-scrape e2e + console + bundle
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def served_driver(tmp_path_factory):
+    wd = str(tmp_path_factory.mktemp("ops_e2e"))
+    d = ClusterDriver(CFG, 3, workdir=wd, timeout_cfg=TO,
+                      health_period=0.0)
+    d.cluster.run_until_elected(0)
+    for i in range(6):
+        d.cluster.submit(0, b"v%d" % i)
+        d.step()
+    d.evaluate_alerts()
+    exp = d.serve_metrics(0)
+    yield d, exp, wd
+    d.stop()
+
+
+def test_driver_serves_metrics_and_healthz(served_driver):
+    d, exp, wd = served_driver
+    assert exp.port > 0
+    st, body = _get(exp.url + "/metrics")
+    assert st == 200
+    text = body.decode()
+    assert "committed_entries_total" in text
+    assert "# TYPE step_batch_entries histogram" in text
+    h = _get_json(exp.url + "/healthz")
+    assert validate_cluster(h) == []
+    assert h["leader"] == d.leader()
+    s = _get_json(exp.url + "/series")
+    assert s["samples"] >= 1 and s["series"]
+    a = _get_json(exp.url + "/alerts")
+    assert "commit_latency_slo_burn" in a["state"]
+    # serve_metrics is idempotent — same exporter back
+    assert d.serve_metrics() is exp
+
+
+def test_console_once_merges_two_sources(served_driver, tmp_path,
+                                         capsys):
+    d, exp, wd = served_driver
+    # a second source kind: one bare replica health file (the shape a
+    # NodeDaemon host writes)
+    hpath = tmp_path / "replica7.health.json"
+    hpath.write_text(json.dumps(dict(
+        replica=7, role=int(Role.LEADER), term=9, leader_id=7,
+        commit=123, apply=120, end=125, head=0, log_headroom=99,
+        inflight=0, ts=time.time())))
+    rc = console_mod.main(["--scrape", exp.url,
+                           "--health", str(hpath), "--once"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "GROUP" in out and "LEADER" in out
+    assert "2 source(s)" in out
+    # the scraped cluster row and the merged member row both render
+    assert str(d.leader()) in out and "123" in out
+    assert "[cluster]" in out and "[replica]" in out
+
+
+def test_console_fleet_view_merges_member_files():
+    """N daemon health files = one cluster seen from N sides: leader
+    = the highest-term LEADER claimant, frontiers = maxima."""
+    mk = lambda r, role, term, commit: dict(     # noqa: E731
+        src=f"h{r}", health=dict(replica=r, role=role, term=term,
+                                 leader_id=1, commit=commit,
+                                 apply=commit, end=commit, head=0,
+                                 log_headroom=9, inflight=0, ts=1.0))
+    view = console_mod.fleet_view([
+        mk(0, int(Role.FOLLOWER), 3, 40),
+        mk(1, int(Role.LEADER), 3, 41),
+        mk(2, int(Role.LEADER), 2, 39),      # stale deposed claimant
+    ])
+    [row] = view["groups"]
+    assert row["leader"] == 1 and row["term"] == 3
+    assert row["commit"] == 41 and row["members"] == 3
+
+
+def test_console_role_leader_pin():
+    assert console_mod.ROLE_LEADER == int(Role.LEADER)
+
+
+def test_fleet_view_tied_leader_terms_no_crash():
+    """Two stale member files claiming LEADER at the SAME term (a
+    deposed leader's last snapshot beside the fresh one) must render,
+    not crash the console on a dict comparison."""
+    mk = lambda r: dict(                         # noqa: E731
+        src=f"h{r}", health=dict(replica=r, role=int(Role.LEADER),
+                                 term=5, leader_id=r, commit=10,
+                                 apply=10, end=10, head=0,
+                                 log_headroom=9, inflight=0, ts=1.0))
+    view = console_mod.fleet_view([mk(0), mk(1)])
+    [row] = view["groups"]
+    assert row["leader"] in (0, 1) and row["term"] == 5
+
+
+def test_scrape_source_parses_503_dead_loop_health():
+    """A dead poll loop answers /healthz with 503 + the full health
+    document; the console must render the loop-error row, not a
+    generic unreachable error."""
+    reg = MetricsRegistry()
+    exp = OpsExporter(
+        registry=reg,
+        health_fn=lambda: dict(leader=-1, replicas=[],
+                               loop_error="RuntimeError('boom')",
+                               ts=time.time()),
+        port=0).start()
+    try:
+        doc = console_mod.scrape_source(exp.url)
+        assert "error" not in doc
+        assert doc["health"]["loop_error"].startswith("RuntimeError")
+        view = console_mod.fleet_view([doc])
+        [hst] = view["hosts"]
+        assert hst["loop_error"]
+        assert "LOOP ERROR" in console_mod.render_table(view)
+    finally:
+        exp.close()
+
+
+def test_bundle_assemble_verify_tamper(served_driver, tmp_path):
+    d, exp, wd = served_driver
+    from rdma_paxos_tpu.obs.audit import write_audit_artifact
+    # force every dump surface the bundle gathers
+    d.obs.spans.write_json(os.path.join(wd, "spans.json"))
+    write_audit_artifact(os.path.join(wd, "audit_dump.json"),
+                         reason="test", obs=d.obs)
+    d.obs.trace.dump_on_failure(os.path.join(wd, "trace_dump.json"),
+                                reason="test")
+    d.obs.metrics.write_json(os.path.join(wd, "metrics.json"))
+    d._health.write(d._health_snapshots(d.cluster.last))
+    d._health.write_cluster(d.health())
+
+    out = str(tmp_path / "bundle.json")
+    assert console_mod.main(["bundle", "--workdir", wd,
+                             "--out", out]) == 0
+    assert console_mod.main(["bundle", "--verify", out]) == 0
+    doc = json.load(open(out))
+    for name in console_mod.REQUIRED_SECTIONS:
+        assert name in doc["sections"], name
+        assert doc["manifest"][name]["sha256"]
+    # series section really is the retention log, concat-mergeable
+    assert doc["sections"]["series"]["lines"]
+    # alert state rode in from the cluster health document
+    assert "commit_latency_slo_burn" in doc["sections"]["alerts"]
+
+    # tamper -> verify fails naming the section
+    doc["sections"]["telemetry"]["counters"]["forged"] = 1
+    json.dump(doc, open(out, "w"))
+    assert console_mod.main(["bundle", "--verify", out]) == 1
+
+    # a bundle missing a core section fails verification
+    doc2 = console_mod.assemble_bundle(workdir=wd)
+    del doc2["sections"]["spans"]
+    del doc2["manifest"]["spans"]
+    out2 = str(tmp_path / "partial.json")
+    console_mod.write_bundle(doc2, out2)
+    assert console_mod.main(["bundle", "--verify", out2]) == 1
+
+
+def test_bundle_from_scrape(served_driver, tmp_path):
+    d, exp, wd = served_driver
+    doc = console_mod.assemble_bundle(scrape=exp.url)
+    # the live endpoints alone provide series/telemetry/alerts/health
+    for name in ("series", "telemetry", "alerts", "health"):
+        assert name in doc["sections"], name
+    assert doc["sections"]["series"]["kind"] == "series"
+    assert "counters" in doc["sections"]["telemetry"]
+
+
+# ---------------------------------------------------------------------------
+# NodeDaemon e2e (single-process world, subprocess-isolated because
+# jax.distributed.initialize is once-per-process)
+# ---------------------------------------------------------------------------
+
+_DAEMON_SCRIPT = r"""
+import json, os, socket, sys, tempfile, urllib.request
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+s = socket.socket(); s.bind(("127.0.0.1", 0))
+port = s.getsockname()[1]; s.close()
+from rdma_paxos_tpu.config import LogConfig
+from rdma_paxos_tpu.runtime.node import NodeDaemon
+wd = tempfile.mkdtemp(prefix="rp_node_ops_")
+cfg = LogConfig(n_slots=128, slot_bytes=128, window_slots=32,
+                batch_slots=16)
+d = NodeDaemon(cfg, process_id=0, num_processes=1,
+               coordinator="127.0.0.1:%d" % port, workdir=wd)
+assert d.exporter is not None and d.exporter.port > 0
+for _ in range(6):
+    d.iterate()
+import time; time.sleep(1.1)      # cross the 1 s alert/health cadence
+for _ in range(3):
+    d.iterate()
+h = json.loads(urllib.request.urlopen(
+    d.exporter.url + "/healthz", timeout=10).read())
+m = urllib.request.urlopen(
+    d.exporter.url + "/metrics", timeout=10).read().decode()
+a = json.loads(urllib.request.urlopen(
+    d.exporter.url + "/alerts", timeout=10).read())
+d.close()
+print(json.dumps(dict(
+    workdir=wd, port=d.exporter.port,
+    health=h, has_role_metric="replica_role" in m,
+    alert_names=sorted(a["state"]),
+    health_file=os.path.exists(
+        os.path.join(wd, "replica0.health.json")),
+    series_lines=sum(1 for _ in open(
+        os.path.join(wd, "replica0.series.jsonl"))))))
+"""
+
+
+def test_node_daemon_serves_ops_plane(tmp_path):
+    """A real NodeDaemon (1-host world) with RP_METRICS_PORT=0: the
+    exporter serves /metrics + /healthz + /alerts on an ephemeral
+    port, the health file + series JSONL land in the workdir, and the
+    console renders the health file afterwards."""
+    env = dict(os.environ, RP_METRICS_PORT="0", JAX_PLATFORMS="cpu")
+    env.pop("RP_AUDIT", None)
+    proc = subprocess.run(
+        [sys.executable, "-c", _DAEMON_SCRIPT],
+        env=env, cwd=os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))),
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["port"] > 0
+    h = out["health"]
+    assert validate(h) == []
+    assert h["replica"] == 0 and h["role"] == int(Role.LEADER)
+    assert out["has_role_metric"]
+    assert "commit_latency_slo_burn" in out["alert_names"]
+    assert out["health_file"] and out["series_lines"] >= 2
+    # the console merges the daemon's health file like any member's
+    view = console_mod.fleet_view(console_mod.load_health_files(
+        [os.path.join(out["workdir"], "replica0.health.json")]))
+    [row] = view["groups"]
+    assert row["leader"] == 0
+
+
+# ---------------------------------------------------------------------------
+# cache-key guard + jit-safety scan (satellite 3)
+# ---------------------------------------------------------------------------
+
+def test_ops_plane_adds_zero_step_cache_keys_outputs_identical():
+    """Exporter attached + series sampling + live scrapes vs a bare
+    driver: step outputs BIT-IDENTICAL, STEP_CACHE unchanged — the
+    whole ops plane is host bookkeeping."""
+    cfg = LogConfig(n_slots=64, slot_bytes=96, window_slots=16,
+                    batch_slots=8)          # geometry unique to this test
+
+    def drive(d, scrape_url=None):
+        d.cluster.run_until_elected(0)
+        for i in range(6):
+            d.cluster.submit(0, b"p%d" % i)
+            d.step()
+            if scrape_url is not None:
+                d.evaluate_alerts()
+                _get(scrape_url + "/metrics")
+                _get_json(scrape_url + "/healthz")
+        return {k: np.array(d.cluster.last[k])
+                for k in ("term", "commit", "end", "apply", "head",
+                          "role")}
+
+    plain = ClusterDriver(cfg, 3, timeout_cfg=TO)
+    try:
+        base = drive(plain)
+    finally:
+        plain.stop()
+    keys_before = set(STEP_CACHE)
+
+    served = ClusterDriver(cfg, 3, timeout_cfg=TO, series_capacity=32)
+    exp = served.serve_metrics(0)
+    try:
+        out = drive(served, scrape_url=exp.url)
+        assert served.series.samples >= 6
+    finally:
+        served.stop()
+    assert set(STEP_CACHE) == keys_before
+    for k, v in base.items():
+        assert np.array_equal(v, out[k]), k
+
+
+def test_jit_safety_scan_covers_ops_plane_modules():
+    """consensus/step.py, ops/*, and parallel/mesh.py run inside
+    jit/shard_map: no ops-plane symbol may be imported there and no
+    call-site pattern may appear in their source; the three new
+    modules themselves never reach into the accelerator stack."""
+    import inspect
+    import re
+
+    import rdma_paxos_tpu.consensus.step as step_mod
+    import rdma_paxos_tpu.ops as ops_pkg
+    import rdma_paxos_tpu.ops.quorum as quorum_mod
+    import rdma_paxos_tpu.parallel.mesh as mesh_mod
+    for mod in (step_mod, ops_pkg, quorum_mod, mesh_mod):
+        for name, val in vars(mod).items():
+            owner = getattr(val, "__module__", None) or ""
+            assert not str(owner).startswith("rdma_paxos_tpu.obs"), (
+                f"{mod.__name__}.{name} comes from {owner}")
+        src = inspect.getsource(mod)
+        for pat in (r"obs\.series", r"obs\.export", r"obs\.console",
+                    r"TimeSeriesStore", r"OpsExporter",
+                    r"render_prometheus", r"serve_metrics",
+                    r"fleet_view", r"assemble_bundle"):
+            assert not re.search(pat, src), (mod.__name__, pat)
+    # and the host-side ops plane never reaches into jit itself
+    import rdma_paxos_tpu.obs.console as console_module
+    import rdma_paxos_tpu.obs.export as export_module
+    import rdma_paxos_tpu.obs.series as series_module
+    for mod in (series_module, export_module, console_module):
+        src = inspect.getsource(mod)
+        clean = src.replace("jax_graft", "")
+        assert "jax" not in clean, mod.__name__
+        assert "jnp" not in src and "shard_map" not in src, \
+            mod.__name__
+
+
+# ---------------------------------------------------------------------------
+# bench smoke
+# ---------------------------------------------------------------------------
+
+def test_export_overhead_bench_smoke():
+    from benchmarks.run_bench import measure_export_overhead
+    cfg = LogConfig(n_slots=256, slot_bytes=128, window_slots=32,
+                    batch_slots=16)
+    out = measure_export_overhead(cfg, steps=40, per_step=4,
+                                  warmup=4, repeats=1,
+                                  sample_period_s=0.0,
+                                  scrape_period_s=0.05)
+    assert out["on"]["committed"] == out["off"]["committed"] > 0
+    assert out["export"]["samples"] > 0
+    assert out["export"]["scrapes"] > 0
+    assert out["export"]["rule_evals"] == out["export"]["samples"]

@@ -158,14 +158,26 @@ def _drive_driver(pipeline: int):
     for conn in conns:
         st = handler(2, conn, b"")
         assert not isinstance(st, int) or st == 0
-    d.run(period=0.001)
     # recorded workload: one intake thread, alternating connections,
-    # no waiting between submissions — the submit order IS the record
+    # no waiting between submissions — the submit order IS the record.
+    # The whole record is queued BEFORE the loop starts and is SIZED
+    # PAST one fused burst's capacity (K_TIERS[-1] * batch_slots,
+    # further clamped by the 127-slot ring): on a fast
+    # idle host, trickling events in against a live loop lets the
+    # readback retire every ticket before the dispatch thread sees a
+    # standing backlog — and a backlog one burst can swallow whole
+    # vanishes at the first dispatch — so _pipeline_ready (which
+    # needs a standing backlog) never engages and the overlap
+    # assertion below races the machine instead of testing the
+    # driver. A pre-queued record longer than one burst makes the
+    # pipelined variant's overlap structural; the serial variant
+    # drains the identical record.
     evs = []
-    for i in range(40):
-        ev = handler(3, conns[i % 2], b"w%02d" % i)
+    for i in range(200):
+        ev = handler(3, conns[i % 2], b"w%03d" % i)
         assert not isinstance(ev, int), (i, ev)
         evs.append(ev)
+    d.run(period=0.001)
     for i, ev in enumerate(evs):
         assert ev.done.wait(30), f"ack {i} never released"
     time.sleep(0.1)          # let follower replay frontiers settle
@@ -184,13 +196,13 @@ def test_driver_pipelined_commit_and_ack_stream_identical():
     assert ds.cluster.max_inflight_dispatches <= 1
     # ack stream: every submission acked exactly once, successfully,
     # identically across the two drivers
-    assert st_s == [0] * 40
+    assert st_s == [0] * 200
     assert st_p == st_s
     # commit stream bit-identity: same entries, same order, same bytes
     assert stream_p == stream_s
     payloads = [p for (_t, _c, _r, p) in stream_s
                 if p.startswith(b"w")]
-    assert payloads == [b"w%02d" % i for i in range(40)]
+    assert payloads == [b"w%03d" % i for i in range(200)]
     # per-connection req stamps strictly increase (no reorder, no dup)
     for conn_sel in (11, 12):
         reqs = [r for (_t, c, r, _p) in stream_p
