@@ -1,0 +1,141 @@
+#!/usr/bin/env python
+"""Host-data-plane perf smoke: vectorized vs scalar, same bytes.
+
+Times the three host hot-path operations (window encode, window decode
++ frame assembly, replay/ack planning) through BOTH implementations in
+``runtime/hostpath.py`` on identical synthetic windows, emits one
+``host_path_speedup_micro`` row per operation plus the aggregate, and
+— with ``--check`` — exits non-zero unless the vectorized path is at
+least as fast as the scalar reference (the loose CI non-regression
+bound: a future PR reintroducing a per-entry Python loop into the
+vectorized functions fails the tier-1 workflow here, before any e2e
+bench would notice). numpy-only — runs in seconds on any CPU.
+
+    python benchmarks/hostpath_bench.py --check
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from rdma_paxos_tpu.consensus.log import (  # noqa: E402
+    M_CONN, M_GEN, M_LEN, M_REQID, M_TYPE, META_W)
+from rdma_paxos_tpu.runtime import hostpath  # noqa: E402
+
+
+def make_take(rng, n, slot_bytes, payload):
+    return [(3, int(rng.randint(1, 1 << 26)), i + 1,
+             rng.bytes(payload)) for i, _ in enumerate(range(n))]
+
+
+def make_window(rng, n, slot_bytes, payload):
+    wm = np.zeros((n, META_W), np.int32)
+    wd = rng.randint(-2**31, 2**31 - 1, size=(n, slot_bytes // 4),
+                     dtype=np.int32)
+    wm[:, M_TYPE] = 3
+    wm[:, M_CONN] = rng.randint(1, 1 << 26, size=n)
+    # ~1/8 own-origin entries (origin 0), the rest remote
+    own = rng.rand(n) < 0.125
+    wm[own, M_CONN] = (0 << 24) | rng.randint(1, 1 << 10, size=int(
+        own.sum()))
+    wm[~own, M_CONN] |= (1 << 24)
+    wm[:, M_REQID] = np.arange(1, n + 1)
+    wm[:, M_LEN] = payload
+    return wm, wd
+
+
+def best_of(fn, rounds, inner):
+    best = float("inf")
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        for _ in range(inner):
+            fn()
+        best = min(best, (time.perf_counter() - t0) / inner)
+    return best
+
+
+def run(n=2048, slot_bytes=128, payload=24, rounds=5, inner=3,
+        json_path=None):
+    rng = np.random.RandomState(7)
+    take = make_take(rng, n, slot_bytes, payload)
+    wm, wd = make_window(rng, n, slot_bytes, payload)
+    data = np.zeros((n, slot_bytes // 4), np.int32)
+    meta = np.zeros((n, META_W), np.int32)
+    du8 = data.view(np.uint8).reshape(n, -1)
+
+    def op_encode():
+        data[:] = 0
+        meta[:] = 0
+        hostpath.pack_window(du8, meta, take, slot_bytes)
+
+    def op_decode():
+        hostpath.decode_batch(wm, wd, n).frames()
+
+    batch = hostpath.decode_batch(wm, wd, n)
+    own = (batch.conns >> 24) == 0
+
+    def op_plan():
+        hostpath.replay_plan(batch, own)
+
+    from benchmarks.reporting import emit
+    results = {}
+    for name, op in (("encode", op_encode), ("decode", op_decode),
+                     ("replay_ack_plan", op_plan)):
+        timings = {}
+        # alternating best-of rounds, the shared A/B methodology
+        for variant in ("scalar", "vectorized"):
+            hostpath.set_vectorized(variant == "vectorized")
+            timings[variant] = best_of(op, rounds, inner)
+        hostpath.set_vectorized(True)
+        speedup = timings["scalar"] / max(timings["vectorized"], 1e-12)
+        results[name] = dict(
+            scalar_us=round(timings["scalar"] * 1e6, 1),
+            vectorized_us=round(timings["vectorized"] * 1e6, 1),
+            speedup=round(speedup, 2))
+        emit("host_path_speedup_micro", round(speedup, 2), "x",
+             detail=dict(op=name, entries=n, payload=payload,
+                         slot_bytes=slot_bytes, **results[name]),
+             json_path=json_path)
+    agg = min(r["speedup"] for r in results.values())
+    emit("host_path_speedup_micro_min", agg, "x",
+         detail=dict(entries=n, payload=payload, ops=results),
+         json_path=json_path)
+    return agg, results
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--entries", type=int, default=2048,
+                    help="entries per synthetic window")
+    ap.add_argument("--payload", type=int, default=24)
+    ap.add_argument("--slot-bytes", type=int, default=128)
+    ap.add_argument("--rounds", type=int, default=5)
+    ap.add_argument("--check", action="store_true",
+                    help="exit non-zero unless vectorized >= scalar "
+                         "on every operation (CI non-regression)")
+    ap.add_argument("--json", default=None)
+    args = ap.parse_args(argv)
+    agg, results = run(n=args.entries, slot_bytes=args.slot_bytes,
+                       payload=args.payload, rounds=args.rounds,
+                       json_path=args.json)
+    for name, r in results.items():
+        print(f"{name:16s} scalar {r['scalar_us']:9.1f} us  "
+              f"vectorized {r['vectorized_us']:9.1f} us  "
+              f"-> {r['speedup']:.2f}x")
+    if args.check and agg < 1.0:
+        print(f"FAIL: vectorized host path slower than scalar "
+              f"(min speedup {agg:.2f}x < 1.0x)")
+        return 1
+    print(f"min speedup {agg:.2f}x" + (" (>= 1.0x OK)"
+                                       if args.check else ""))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -92,6 +92,15 @@ def main():
                     help="rounds per variant for the pipeline on/off "
                          "A/B (alternating best-of); emits a "
                          "pipeline_speedup row. 0 disables")
+    ap.add_argument("--scan", type=int, default=0, metavar="K",
+                    help="device-resident K-window scan tier (see "
+                         "run_bench --scan): one consolidated "
+                         "readback per up-to-K fused steps")
+    ap.add_argument("--ab-hostpath", type=int, default=2,
+                    help="with --scan: rounds per variant for the "
+                         "host-path A/B (vectorized+scan vs scalar "
+                         "reference+no-scan, alternating best-of); "
+                         "emits host_path_speedup. 0 disables")
     args = ap.parse_args()
 
     try:
@@ -123,7 +132,13 @@ def main():
         timeout_cfg=TimeoutConfig(elec_timeout_low=0.5,
                                   elec_timeout_high=1.0),
         fanout=args.fanout, sync_period=args.sync_period,
-        pipeline=args.pipeline_depth)
+        pipeline=args.pipeline_depth, scan=bool(args.scan))
+    if args.scan:
+        from rdma_paxos_tpu.runtime.sim import cap_scan_tiers
+        try:
+            cap_scan_tiers(driver.cluster, args.scan)
+        except ValueError as e:
+            raise SystemExit(f"--scan: {e}")
     apps = []
     for r, port in enumerate(ports):
         env = dict(os.environ)
@@ -225,6 +240,26 @@ def main():
     print("\n".join(l for l in out.splitlines()
                     if "requests per second" in l or "SET" in l))
 
+    ab_host = None
+    if args.scan and args.ab_hostpath > 0:
+        # host-path A/B on the REFERENCE headline workload: scalar
+        # per-entry host loops + no scan vs the vectorized data plane
+        # + K-window scan tier (alternating best-of, same core)
+        from benchmarks.reporting import ab_variant_rounds
+        from rdma_paxos_tpu.runtime import hostpath as hostpath_mod
+
+        def apply_variant(on: bool):
+            hostpath_mod.set_vectorized(on)
+            driver.cluster.scan = on
+
+        ab_host = ab_variant_rounds(driver, args.ab_hostpath,
+                                    apply_variant,
+                                    lambda: bench_round()[0])
+        if ab_host["off"] and ab_host["on"]:
+            print(f"host-path A/B: {ab_host['off']:.0f} SET/s scalar "
+                  f"vs {ab_host['on']:.0f} SET/s vectorized+scan -> "
+                  f"{ab_host['on'] / ab_host['off']:.2f}x")
+
     ab = None
     if args.ab_pipeline > 0 and args.pipeline_depth >= 2:
         # pipeline on/off A/B on the SAME core, same day — alternating
@@ -269,6 +304,24 @@ def main():
                      phases=dict(sorted(main_phases.items())),
                      leader_dbsize=int(lead_size.lstrip(b":") or 0)),
          obs=driver.obs)
+    if ab_host is not None and ab_host["off"] and ab_host["on"]:
+        emit("host_path_speedup",
+             round(ab_host["on"] / ab_host["off"], 3), "x",
+             detail=dict(off_ops_per_sec=ab_host["off"],
+                         on_ops_per_sec=ab_host["on"],
+                         off_us_per_op=round(1e6 / ab_host["off"], 2),
+                         on_us_per_op=round(1e6 / ab_host["on"], 2),
+                         rounds=args.ab_hostpath,
+                         n_per_round=args.n,
+                         scan_k=max(driver.cluster.K_TIERS),
+                         scan_dispatches=int(
+                             driver.cluster.scan_dispatches),
+                         shared_core_caveat=(
+                             "alternating best-of on shared CPU "
+                             "cores"),
+                         phases_on=ab_host["phases_on"],
+                         phases_off=ab_host["phases_off"]),
+             obs=driver.obs)
     if ab is not None and ab["off"] and ab["on"]:
         emit("pipeline_speedup", round(ab["on"] / ab["off"], 3), "x",
              detail=dict(off_ops_per_sec=ab["off"],

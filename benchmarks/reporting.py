@@ -88,6 +88,30 @@ def phase_accumulate(driver, pre: dict, agg: dict) -> dict:
     return agg
 
 
+def ab_variant_rounds(driver, rounds: int, apply_variant,
+                      run_once) -> dict:
+    """Generic alternating best-of A/B on the same core (the shared
+    methodology): ``apply_variant(on: bool)`` flips the measured
+    delta before each round, ``run_once()`` returns ops/s (None/0
+    rounds are skipped in the best-of). Per-variant phase attribution
+    rides the result. The ON configuration is restored before
+    returning."""
+    ab = {"off": 0.0, "on": 0.0}
+    phases = {"off": {}, "on": {}}
+    for _ in range(rounds):
+        for variant in ("off", "on"):
+            apply_variant(variant == "on")
+            pre = phase_snapshot(driver)
+            ops = run_once()
+            phase_accumulate(driver, pre, phases[variant])
+            if ops:
+                ab[variant] = max(ab[variant], float(ops))
+    apply_variant(True)
+    return dict(off=ab["off"], on=ab["on"],
+                phases_on=dict(sorted(phases["on"].items())),
+                phases_off=dict(sorted(phases["off"].items())))
+
+
 def ab_pipeline_rounds(driver, rounds: int, depth: int, run_once) -> dict:
     """Alternating best-of pipeline on/off A/B on the same core (the
     ``--audit`` overhead methodology, shared by run_bench and

@@ -94,6 +94,77 @@ def _measure_flag_overhead(flag, proof, cfg=None, *, n_replicas=3,
     return out
 
 
+def measure_host_path(cfg=None, *, n_replicas=3, steps=40,
+                      per_step=2000, payload=24, warmup=4, repeats=4,
+                      scan_k=8):
+    """The host-data-plane A/B on the engine closed loop (the
+    ``_measure_flag_overhead`` methodology — prewarmed clusters,
+    ALTERNATING best-of rounds, same core): identical burst-driven
+    workload through
+
+    * ``off`` — the scalar reference host loops (per-entry pack /
+      decode / replay-plan) + the plain burst path (per-field stacked
+      readback + standalone replay-fetch dispatches);
+    * ``on``  — the vectorized window batch ops + the device-resident
+      K-window scan tier (one consolidated readback, replay rows
+      in-dispatch).
+
+    Committed-entries/s per variant, the speedup, and the scan's
+    dispatch accounting (scan vs fetch dispatches) ride the row."""
+    import time as _t
+
+    from rdma_paxos_tpu.config import LogConfig
+    from rdma_paxos_tpu.runtime import hostpath
+    from rdma_paxos_tpu.runtime.sim import SimCluster, cap_scan_tiers
+
+    if cfg is None:
+        # the small-SET geometry: 64-byte slots fit a redis-style SET
+        # fragment, and the thin window keeps the XLA-CPU window
+        # programs from drowning the host-path delta being measured
+        cfg = LogConfig(n_slots=32768, slot_bytes=64,
+                        window_slots=1024, batch_slots=1024)
+    blob = b"x" * payload
+    clusters = {}
+    for variant in ("off", "on"):
+        c = SimCluster(cfg, n_replicas, fanout="psum")
+        cap_scan_tiers(c, scan_k)
+        c.run_until_elected(0)
+        c.scan = variant == "on"   # prewarm compiles the ON tiers too
+        c.prewarm()
+        for _ in range(warmup):
+            c.submit_many(0, [(3, 1, 0, blob)] * per_step)
+            c.step_burst()
+        clusters[variant] = c
+    out = {v: dict(steps=steps, seconds=None, committed=None,
+                   ops_per_sec=0.0) for v in clusters}
+    for _ in range(repeats):
+        for variant, c in clusters.items():
+            hostpath.set_vectorized(variant == "on")
+            base = int(c.last["commit"].max()) + c.rebased_total
+            t0 = _t.perf_counter()
+            for _ in range(steps):
+                c.submit_many(0, [(3, 1, 0, blob)] * per_step)
+                c.step_burst()
+            while (int(c.last["commit"].min())
+                   < int(c.last["end"].max())):
+                c.step_burst()
+            dt = _t.perf_counter() - t0
+            done = (int(c.last["commit"].max()) + c.rebased_total
+                    - base)
+            ops = round(done / dt, 1)
+            if ops > out[variant]["ops_per_sec"]:
+                out[variant] = dict(steps=steps, seconds=round(dt, 4),
+                                    committed=done, ops_per_sec=ops)
+    hostpath.set_vectorized(True)
+    on_c = clusters["on"]
+    out["scan"] = dict(scan_dispatches=int(on_c.scan_dispatches),
+                       scan_k=max(on_c.K_TIERS))
+    out["speedup"] = round(
+        out["on"]["ops_per_sec"]
+        / max(out["off"]["ops_per_sec"], 1e-9), 3)
+    return out
+
+
 def measure_audit_overhead(cfg=None, **kw):
     """A/B the compiled-step digest chain (``audit=``); the proof is
     the ON cluster's ledger summary — the workload ran digest-checked
@@ -465,6 +536,12 @@ def main():
     ap.add_argument("--clients", type=int, default=4)
     ap.add_argument("--port-base", type=int, default=7600)
     ap.add_argument("--period", type=float, default=0.02)
+    # log geometry (defaults = the historical run_bench shape; the
+    # REDIS_r05 headline geometry is 8192/256/1024/1024)
+    ap.add_argument("--n-slots", type=int, default=2048)
+    ap.add_argument("--slot-bytes", type=int, default=512)
+    ap.add_argument("--window-slots", type=int, default=256)
+    ap.add_argument("--batch-slots", type=int, default=256)
     ap.add_argument("--pipeline", type=int, default=1,
                     help="commands per client batch (redis-benchmark -P)")
     ap.add_argument("--threaded-app", action="store_true",
@@ -500,6 +577,21 @@ def main():
                     help="driver dispatch-pipeline depth (encode batch "
                          "k+1 while batch k runs on the device; 0/1 = "
                          "fully serial loop)")
+    ap.add_argument("--scan", type=int, default=0, metavar="K",
+                    help="device-resident K-window scan tier: burst "
+                         "dispatches run up to K fused protocol steps "
+                         "and return ONE consolidated minimal readback "
+                         "(scalar matrix + in-dispatch replay rows) — "
+                         "the host pays one dispatch + one transfer "
+                         "per K steps. K caps the fused tier "
+                         "(2/4/8/16). 0 = off")
+    ap.add_argument("--ab-hostpath", type=int, default=2,
+                    help="with --scan: rounds per variant for the "
+                         "host-path A/B (vectorized data plane + scan "
+                         "tier ON vs scalar reference loops + scan "
+                         "OFF; alternating best-of); emits the "
+                         "host_path_speedup row with per-phase us "
+                         "attribution. 0 disables")
     ap.add_argument("--ab-pipeline", type=int, default=2,
                     help="rounds per variant for the pipeline on/off "
                          "A/B (alternating best-of, the --audit "
@@ -588,8 +680,9 @@ def main():
     from rdma_paxos_tpu.config import LogConfig, TimeoutConfig
     from rdma_paxos_tpu.runtime.driver import ClusterDriver
 
-    cfg = LogConfig(n_slots=2048, slot_bytes=512, window_slots=256,
-                    batch_slots=256)
+    cfg = LogConfig(n_slots=args.n_slots, slot_bytes=args.slot_bytes,
+                    window_slots=args.window_slots,
+                    batch_slots=args.batch_slots)
     ports = [args.port_base + i for i in range(args.replicas)]
     wd = tempfile.mkdtemp(prefix="rp_bench_")
     subprocess.run(["make", "-C", NATIVE], check=True, capture_output=True)
@@ -609,13 +702,20 @@ def main():
             cfg, args.replicas, args.groups, workdir=wd,
             app_ports=ports, timeout_cfg=tcfg, fanout="psum",
             fence=args.fence, audit=args.audit,
-            telemetry=args.telemetry, pipeline=args.pipeline_depth)
+            telemetry=args.telemetry, pipeline=args.pipeline_depth,
+            scan=bool(args.scan))
     else:
         driver = ClusterDriver(
             cfg, args.replicas, workdir=wd, app_ports=ports,
             timeout_cfg=tcfg, fanout="psum", fence=args.fence,
             audit=args.audit, telemetry=args.telemetry,
-            pipeline=args.pipeline_depth)
+            pipeline=args.pipeline_depth, scan=bool(args.scan))
+    if args.scan:
+        from rdma_paxos_tpu.runtime.sim import cap_scan_tiers
+        try:
+            cap_scan_tiers(driver.cluster, args.scan)
+        except ValueError as e:
+            raise SystemExit(f"--scan: {e}")
     if args.trace:
         # 100% sampling (the default is rate-limited); capacity sized
         # so a full run's spans are retained for the export
@@ -869,6 +969,53 @@ def main():
                          phases_off=ab["phases_off"]),
              obs=driver.obs, json_path=args.json)
 
+    if args.scan and args.ab_hostpath > 0:
+        # host-path A/B — the one methodology every overhead/speedup
+        # row shares (alternating best-of on the same shared core):
+        # OFF = scalar per-entry host loops + per-field burst readback
+        # + standalone replay fetch dispatches (the pre-PR data
+        # plane); ON = vectorized window batch ops + the K-window
+        # scan tier's consolidated readback. Phase sums attribute
+        # exactly where the us went (host_encode / apply_replay_ack /
+        # quorum_wait).
+        from benchmarks.reporting import ab_variant_rounds
+        from rdma_paxos_tpu.runtime import hostpath as hostpath_mod
+
+        def apply_variant(on: bool):
+            hostpath_mod.set_vectorized(on)
+            driver.cluster.scan = on
+
+        ab = ab_variant_rounds(driver, args.ab_hostpath,
+                               apply_variant,
+                               lambda: run_wave(args.requests)[0])
+        speedup = ab["on"] / max(ab["off"], 1e-9)
+
+        def us_per_op(ops):
+            return round(1e6 / ops, 2) if ops else None
+
+        print(f"host-path A/B: {ab['off']:.0f} ops/s scalar vs "
+              f"{ab['on']:.0f} ops/s vectorized+scan -> "
+              f"{speedup:.2f}x ({us_per_op(ab['off'])} -> "
+              f"{us_per_op(ab['on'])} us/op; "
+              f"{driver.cluster.scan_dispatches} scan dispatches)")
+        emit("host_path_speedup", round(speedup, 3), "x",
+             detail=dict(off_ops_per_sec=round(ab["off"], 1),
+                         on_ops_per_sec=round(ab["on"], 1),
+                         off_us_per_op=us_per_op(ab["off"]),
+                         on_us_per_op=us_per_op(ab["on"]),
+                         rounds=args.ab_hostpath,
+                         requests_per_round=n,
+                         scan_k=max(driver.cluster.K_TIERS),
+                         scan_dispatches=int(
+                             driver.cluster.scan_dispatches),
+                         groups=(args.groups if sharded_e2e else 1),
+                         shared_core_caveat=(
+                             "alternating best-of on shared CPU "
+                             "cores; see REDIS_r06"),
+                         phases_on=ab["phases_on"],
+                         phases_off=ab["phases_off"]),
+             obs=driver.obs, json_path=args.json)
+
     if args.audit:
         # e2e audit verdict (the whole workload ran digest-checked)
         # plus the A/B overhead row the acceptance criteria ask for
@@ -923,6 +1070,24 @@ def main():
     for a in apps:
         a.kill()
         a.wait()
+
+    if args.scan and args.ab_hostpath > 0:
+        # engine-closed-loop host-path A/B on the now-quiet process
+        # (the --telemetry reasoning): isolates the data-plane delta
+        # from client-thread GIL contention and app socket I/O — the
+        # e2e row above measures the whole serving stack, this row
+        # measures the driver host path itself
+        hp = measure_host_path()
+        print(f"host-path engine A/B: {hp['off']['ops_per_sec']} "
+              f"ops/s scalar+burst vs {hp['on']['ops_per_sec']} "
+              f"ops/s vectorized+scan -> {hp['speedup']}x "
+              f"({hp['scan']['scan_dispatches']} scan dispatches)")
+        emit("host_path_speedup_engine", hp["speedup"], "x",
+             detail=dict(off=hp["off"], on=hp["on"], **hp["scan"],
+                         shared_core_caveat=(
+                             "engine closed loop, alternating "
+                             "best-of on shared CPU cores")),
+             obs=driver.obs, json_path=args.json)
 
     if args.repair:
         # on the now-quiet process (same reasoning as --telemetry):

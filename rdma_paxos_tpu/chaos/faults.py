@@ -307,7 +307,8 @@ def restart_replica(cluster, r: int, link: LinkModel,
     cluster.applied[r] = snap.index
     if donor != r:
         # store transfer: the donor's persisted history replaces r's
-        cluster.replayed[r] = list(cluster.replayed[donor])
+        from rdma_paxos_tpu.runtime.hostpath import stream_copy
+        cluster.replayed[r] = stream_copy(cluster.replayed[donor])
         cluster.frames[r] = []
     cluster.need_recovery.discard(r)
     link.down.discard(r)

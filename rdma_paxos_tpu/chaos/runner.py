@@ -262,6 +262,7 @@ class NemesisRunner:
                  skip_incompatible_faults: bool = False,
                  obs: Optional[Observability] = None,
                  audit: bool = True, pipeline: int = 0,
+                 scan: bool = False,
                  leases: bool = True,
                  repair: bool = False,
                  corrupt_step: Optional[int] = None,
@@ -362,6 +363,22 @@ class NemesisRunner:
         # tests in tests/test_pipeline.py assert bit-identity too).
         self.pipeline = int(pipeline)
         self._pl: List[tuple] = []  # (logical step id, ticket) in flight
+        # scan=True: stable-leader traffic iterations ride the
+        # device-resident K-window scan tier (cluster.step_burst with
+        # the scan program — fused steps, consolidated readback,
+        # in-dispatch replay rows), DRAINING TO THE SERIAL single-step
+        # path the moment a fault event is due, a timer fires, or the
+        # leader is unknown — so a leader crash mid-run is handled by
+        # exactly the election machinery the serial drive uses. The
+        # verdict must stay green: the scan tier is bit-identical to
+        # serial steps (tests/test_scan.py pins it engine-level).
+        self.scan = bool(scan)
+        if scan:
+            if pipeline >= 2:
+                raise ValueError(
+                    "runner scan mode and pipelined mode are "
+                    "mutually exclusive (bursts are serial-path)")
+            self.cluster.scan = True
 
     # ------------------------------------------------------------------
 
@@ -412,14 +429,9 @@ class NemesisRunner:
         cluster. Ring room is checked separately (``_room_ok``) AFTER
         the workload issues this step's entries — a pre-issue check
         would not cover them."""
-        if self.pipeline < 2 or leader < 0:
+        if self.pipeline < 2:
             return False
-        if self._corrupt_due(t):
-            return False            # corruption is serial state surgery
-        if self.repairer is not None and self.repairer.needs_drain():
-            return False            # repairs drain in-flight tickets
-        c = self.cluster
-        return c.last is not None and not self.schedule.due(t)
+        return self._stable_window(t, leader)
 
     def _corrupt_due(self, t: int) -> bool:
         return (self.corrupt_step is not None
@@ -450,9 +462,48 @@ class NemesisRunner:
                                          - int(last["head"][r]))
             for r in range(self.R))
 
+    def _stable_window(self, t: int, leader: int) -> bool:
+        """The shared fused-dispatch eligibility predicate (pipelined
+        AND scan drives): a known leader, an initialized cluster, no
+        fault event due this step, no corruption pending, no repair
+        needing a drained serial iteration."""
+        if leader < 0:
+            return False
+        if self._corrupt_due(t):
+            return False
+        if self.repairer is not None and self.repairer.needs_drain():
+            return False
+        return (self.cluster.last is not None
+                and not self.schedule.due(t))
+
+    def _scan_eligible(self, t: int, leader: int) -> bool:
+        """The scan tier's window: the shared stable-window rule PLUS
+        no per-step-random link fault active. A K-fused dispatch
+        samples the link model's effective mask ONCE for all K steps,
+        so active drop/delay/dup state (whose randomness keys on the
+        per-step clock) would be under-injected inside a scan — drain
+        to the serial path until it clears. Static masks (crashes,
+        blocks, partitions) apply identically on every fused step and
+        fuse soundly."""
+        if not self.scan:
+            return False
+        if self.link.drop or self.link.delay or self.link.dup:
+            return False
+        return self._stable_window(t, leader)
+
     def _one_step(self, t: int, leader: int,
                   violations: List[dict]) -> int:
         self.history.set_clock(t)
+        if self._scan_eligible(t, leader):
+            self.workload.issue(t, leader, self.link.down)
+            timeouts = self.timers.fire(self._timer_excluded())
+            if (not timeouts and self._room_ok()
+                    and any(len(q) for q in self.cluster.pending)):
+                # K-window scan dispatch (K sized to the backlog)
+                res = self.cluster.step_burst()
+            else:
+                res = self.cluster.step(timeouts=timeouts)
+            return self._observe_res(t, res, violations)
         if self._pipeline_eligible(t, leader):
             self.workload.issue(t, leader, self.link.down)
             timeouts = self.timers.fire(self._timer_excluded())

@@ -977,6 +977,54 @@ def group_step(
     return jax.vmap(vstep, in_axes=(0, 0))
 
 
+# ---------------------------------------------------------------------------
+# device-resident K-window scan: the consolidated minimal readback
+# ---------------------------------------------------------------------------
+
+# per-replica scalar outputs the host rules actually consume, packed
+# into ONE [..., len(SCAN_KEYS)] i32 matrix by :func:`scan_scalars` so
+# a K-step scan dispatch returns a single consolidated array instead
+# of one device->host transfer per field. ``accepted`` carries the
+# CUMULATIVE accepted count across the scan (the burst-sum semantics,
+# computed in-program). Order is part of the host contract
+# (runtime/sim.py unpacks by index) — append only.
+SCAN_KEYS = ("term", "role", "leader_id", "voted_term", "voted_for",
+             "head", "apply", "commit", "end", "hb_seen",
+             "became_leader", "acked", "accepted",
+             "leadership_verified", "rebase_delta", "burst_hint")
+
+
+def scan_scalars(out: StepOutput, accepted_total: jax.Array
+                 ) -> jax.Array:
+    """Stack one step's :data:`SCAN_KEYS` outputs along a trailing
+    axis (``[..., len(SCAN_KEYS)]`` i32) — the scan tier's one-array
+    scalar readback. ``accepted_total`` substitutes the cumulative
+    accepted count for the per-step ``accepted`` field."""
+    cols = [accepted_total if k == "accepted" else getattr(out, k)
+            for k in SCAN_KEYS]
+    return jnp.stack([c.astype(jnp.int32) for c in cols], axis=-1)
+
+
+def scan_readback(out: StepOutput, accepted_total: jax.Array, *,
+                  audit: bool, telemetry: bool) -> dict:
+    """One scan step's readback dict — the SINGLE assembly rule every
+    scan builder uses (sim, group, spmd, spmd-group), so the
+    consolidated-readback contract can never drift between engines:
+    the :func:`scan_scalars` matrix + ``peer_acked``, plus the
+    per-step audit windows / telemetry vector only when those
+    variants are compiled."""
+    ys = dict(scal=scan_scalars(out, accepted_total),
+              peer_acked=out.peer_acked)
+    if audit:
+        ys.update(audit_start=out.audit_start,
+                  audit_digest=out.audit_digest,
+                  audit_term=out.audit_term,
+                  audit_commit=out.commit)
+    if telemetry:
+        ys["telemetry"] = out.telemetry
+    return ys
+
+
 def fetch_window(log: Log, start: jax.Array, *, window_slots: int):
     """Host helper: gather ``window_slots`` entries beginning at ``start`` —
     used by the driver to read newly committed payloads for replay/persist

@@ -502,6 +502,10 @@ PHASE_DEVICE_SYNC = "device_sync"        # explicit fence (opt-in)
 PHASE_QUORUM_WAIT = "quorum_wait"        # blocking commit readback
 PHASE_APPLY = "apply"                    # committed-window replay
 PHASE_ACK_RELEASE = "ack_release"        # waiter release + latency obs
+PHASE_APPLY_REPLAY_ACK = "apply_replay_ack"  # driver store/replay/ack
+                                         # sweep (whole-batch, per
+                                         # replica) — the host_path
+                                         # A/B attribution phase
 
 
 class StepPhaseProfiler:
@@ -522,7 +526,7 @@ class StepPhaseProfiler:
     BUCKETS_US = LATENCY_BUCKETS_US
     PHASES = (PHASE_HOST_ENCODE, PHASE_DEVICE_DISPATCH,
               PHASE_DEVICE_SYNC, PHASE_QUORUM_WAIT, PHASE_APPLY,
-              PHASE_ACK_RELEASE)
+              PHASE_ACK_RELEASE, PHASE_APPLY_REPLAY_ACK)
 
     def __init__(self, metrics=None, *, fence: bool = False,
                  replica: int = -1):

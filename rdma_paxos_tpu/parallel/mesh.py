@@ -200,6 +200,266 @@ def build_sim_burst(cfg: LogConfig, n_replicas: int, *,
     return jax.jit(burst, donate_argnums=(0,) if donate else ())
 
 
+def build_sim_scan(cfg: LogConfig, n_replicas: int, *,
+                   replay_slots: int,
+                   use_pallas: bool = False, interpret: bool = False,
+                   donate: bool = True, fanout: str = "gather",
+                   audit: bool = False, telemetry: bool = False):
+    """The device-resident K-window scan tier: K fused protocol steps
+    (the :func:`build_sim_burst` ``lax.scan``) returning ONE
+    consolidated minimal readback instead of the full per-step output
+    stacks — only what the host rules consume:
+
+    * ``scal`` ``[K, R, len(SCAN_KEYS)]`` i32 — the per-step scalar
+      matrix (``accepted`` cumulative; the host reads row ``[-1]``),
+    * ``peer_acked`` ``[K, R, R]`` — the failure detector's input,
+    * ``replay_data``/``replay_meta`` — ``replay_slots`` committed
+      rows per replica starting at the host's PRE-scan apply cursors,
+      extracted from the post-scan log INSIDE the same dispatch, so
+      the host's replay sweep needs no separate fetch dispatch,
+    * per-step audit windows / telemetry vectors, only when those
+      variants are compiled (the ``audit=``/``telemetry=`` guard
+      discipline — default programs carry neither).
+
+    The protocol computation is exactly the burst's (stable step,
+    same inputs, same donation), so scan outputs are bit-identical to
+    K serial steps — pinned by ``tests/test_scan.py``. Engines cache
+    the compiled fn under distinct ``"scan"``-marked STEP_CACHE keys:
+    scan-off clusters' key sets and programs are untouched."""
+    import jax.numpy as jnp
+    from jax import lax
+    from rdma_paxos_tpu.consensus.log import extract_window
+    from rdma_paxos_tpu.consensus.step import scan_readback
+
+    core = functools.partial(
+        replica_step, cfg=cfg, n_replicas=n_replicas,
+        axis_name=REPLICA_AXIS, use_pallas=use_pallas,
+        interpret=interpret, fanout=fanout, elections=False,
+        audit=audit, telemetry=telemetry)
+    vstep = jax.vmap(core, in_axes=(0, 0), axis_name=REPLICA_AXIS)
+    vfetch = jax.vmap(lambda log, s: extract_window(
+        log, s, replay_slots))
+
+    def scan(state_b, datas, metas, counts, peer_mask, applied,
+             qdepth):
+        zeros_r = jnp.zeros((n_replicas,), jnp.int32)
+
+        def body(carry, xs):
+            st, acc = carry
+            d, m, c = xs
+            inp = StepInput(
+                batch_data=d, batch_meta=m, batch_count=c,
+                timeout_fired=zeros_r, peer_mask=peer_mask,
+                apply_done=applied, queue_depth=qdepth)
+            st, out = vstep(st, inp)
+            acc = acc + out.accepted
+            ys = scan_readback(out, acc, audit=audit,
+                               telemetry=telemetry)
+            return (st, acc), ys
+
+        (st, _acc), ys = lax.scan(body, (state_b, zeros_r),
+                                  (datas, metas, counts))
+        wd, wm = vfetch(st.log, applied)
+        ys["replay_data"] = wd
+        ys["replay_meta"] = wm
+        return st, ys
+    return jax.jit(scan, donate_argnums=(0,) if donate else ())
+
+
+def build_sim_group_scan(cfg: LogConfig, n_replicas: int, *,
+                         replay_slots: int,
+                         use_pallas: bool = False,
+                         interpret: bool = False,
+                         donate: bool = True, fanout: str = "gather",
+                         audit: bool = False,
+                         telemetry: bool = False):
+    """:func:`build_sim_scan` with a leading ``group`` batch axis —
+    the sharded engine's K-window scan tier (inputs shaped like
+    :func:`build_sim_group_burst`; readback dict axes gain ``G``)."""
+    import jax.numpy as jnp
+    from jax import lax
+    from rdma_paxos_tpu.consensus.log import extract_window
+    from rdma_paxos_tpu.consensus.step import group_step, scan_readback
+
+    gstep = group_step(cfg=cfg, n_replicas=n_replicas,
+                       axis_name=REPLICA_AXIS, use_pallas=use_pallas,
+                       interpret=interpret, fanout=fanout,
+                       elections=False, audit=audit,
+                       telemetry=telemetry)
+    vfetch = jax.vmap(jax.vmap(lambda log, s: extract_window(
+        log, s, replay_slots)))
+
+    def scan(state_gb, datas, metas, counts, peer_mask, applied,
+             qdepth):
+        zeros_gr = jnp.zeros_like(counts[0])
+
+        def body(carry, xs):
+            st, acc = carry
+            d, m, c = xs
+            inp = StepInput(
+                batch_data=d, batch_meta=m, batch_count=c,
+                timeout_fired=zeros_gr, peer_mask=peer_mask,
+                apply_done=applied, queue_depth=qdepth)
+            st, out = gstep(st, inp)
+            acc = acc + out.accepted
+            ys = scan_readback(out, acc, audit=audit,
+                               telemetry=telemetry)
+            return (st, acc), ys
+
+        (st, _acc), ys = lax.scan(body, (state_gb, zeros_gr),
+                                  (datas, metas, counts))
+        wd, wm = vfetch(st.log, applied)
+        ys["replay_data"] = wd
+        ys["replay_meta"] = wm
+        return st, ys
+    return jax.jit(scan, donate_argnums=(0,) if donate else ())
+
+
+def build_spmd_group_scan(cfg: LogConfig, n_replicas: int, mesh: Mesh,
+                          *, replay_slots: int,
+                          use_pallas: bool = False,
+                          interpret: bool = False,
+                          donate: bool = True, fanout: str = "gather",
+                          audit: bool = False,
+                          telemetry: bool = False):
+    """:func:`build_sim_group_scan` over the 2-D ``(group, replica)``
+    mesh: the K-window scan (fused steps + consolidated readback +
+    in-dispatch replay-window extraction) compiled via ``shard_map``.
+    Each device extracts its own replicas' replay rows locally; the
+    out_specs gather assembles the global ``[G, R, ...]`` arrays the
+    host bookkeeping expects — same host code as the vmap engine."""
+    import jax.numpy as jnp
+    from jax import lax
+    from rdma_paxos_tpu.consensus.log import Log, extract_window
+    from rdma_paxos_tpu.consensus.step import scan_readback
+
+    core = functools.partial(
+        replica_step, cfg=cfg, n_replicas=n_replicas,
+        axis_name=REPLICA_AXIS, use_pallas=use_pallas,
+        interpret=interpret, fanout=fanout, elections=False,
+        audit=audit, telemetry=telemetry)
+    vcore = jax.vmap(core, in_axes=(0, 0))      # local groups, unnamed
+
+    def per_device(state_b, datas_b, metas_b, counts_b, peer_b,
+                   applied_b, qdepth_b):
+        st = jax.tree.map(lambda x: x[:, 0], state_b)   # [Gl, ...]
+        zeros_g = jnp.zeros_like(counts_b[0, :, 0])     # [Gl]
+
+        def body(carry, xs):
+            s, acc = carry
+            d, m, c = xs                # d: [Gl, 1, B, sw] etc.
+            inp = StepInput(
+                batch_data=d[:, 0], batch_meta=m[:, 0],
+                batch_count=c[:, 0], timeout_fired=zeros_g,
+                peer_mask=peer_b[:, 0], apply_done=applied_b[:, 0],
+                queue_depth=qdepth_b[:, 0])
+            s, out = vcore(s, inp)
+            acc = acc + out.accepted
+            ys = scan_readback(out, acc, audit=audit,
+                               telemetry=telemetry)
+            return (s, acc), ys
+
+        (st, _acc), ys = lax.scan(body, (st, zeros_g),
+                                  (datas_b, metas_b, counts_b))
+        wd, wm = jax.vmap(lambda buf, s: extract_window(
+            Log(buf=buf), s, replay_slots))(st.log.buf,
+                                            applied_b[:, 0])
+        out = {k: jax.tree.map(lambda x: x[:, :, None], v)
+               for k, v in ys.items()}           # [K, Gl, 1, ...]
+        out["replay_data"] = wd[:, None]
+        out["replay_meta"] = wm[:, None]
+        return (jax.tree.map(lambda x: x[:, None], st), out)
+
+    spec_k = P(None, GROUP_AXIS, REPLICA_AXIS)
+    out_spec = dict(scal=spec_k, peer_acked=spec_k,
+                    replay_data=P(GROUP_AXIS, REPLICA_AXIS),
+                    replay_meta=P(GROUP_AXIS, REPLICA_AXIS))
+    if audit:
+        out_spec.update(audit_start=spec_k, audit_digest=spec_k,
+                        audit_term=spec_k, audit_commit=spec_k)
+    if telemetry:
+        out_spec["telemetry"] = spec_k
+    mapped = _shard_map(
+        per_device, mesh=mesh,
+        in_specs=(P(GROUP_AXIS, REPLICA_AXIS),
+                  P(None, GROUP_AXIS, REPLICA_AXIS),
+                  P(None, GROUP_AXIS, REPLICA_AXIS),
+                  P(None, GROUP_AXIS, REPLICA_AXIS),
+                  P(GROUP_AXIS, REPLICA_AXIS),
+                  P(GROUP_AXIS, REPLICA_AXIS),
+                  P(GROUP_AXIS, REPLICA_AXIS)),
+        out_specs=(P(GROUP_AXIS, REPLICA_AXIS), out_spec))
+    return jax.jit(mapped, donate_argnums=(0,) if donate else ())
+
+
+def build_spmd_scan(cfg: LogConfig, n_replicas: int, mesh: Mesh, *,
+                    replay_slots: int,
+                    use_pallas: bool = False, interpret: bool = False,
+                    donate: bool = True, fanout: str = "psum",
+                    audit: bool = False, telemetry: bool = False):
+    """:func:`build_sim_scan` over a real 1-D replica mesh — the
+    multi-host daemon's K-window scan tier: K fused steps + the
+    consolidated scalar matrix + each host's OWN replay window
+    extracted from its local log shard inside the one collective
+    dispatch (the per-iteration ``fetch_local_window`` dispatches of
+    the lock-step loop disappear)."""
+    import jax.numpy as jnp
+    from jax import lax
+    from rdma_paxos_tpu.consensus.log import extract_window
+    from rdma_paxos_tpu.consensus.step import scan_readback
+
+    core = functools.partial(
+        replica_step, cfg=cfg, n_replicas=n_replicas,
+        axis_name=REPLICA_AXIS, use_pallas=use_pallas,
+        interpret=interpret, fanout=fanout, elections=False,
+        audit=audit, telemetry=telemetry)
+
+    def per_device(state_b, datas_b, metas_b, counts_b, peer_b,
+                   applied_b, qdepth_b):
+        st = _squeeze(state_b)
+
+        def body(carry, xs):
+            s, acc = carry
+            d, m, c = xs
+            inp = StepInput(
+                batch_data=d[0], batch_meta=m[0], batch_count=c[0],
+                timeout_fired=jnp.zeros((), jnp.int32),
+                peer_mask=peer_b[0], apply_done=applied_b[0],
+                queue_depth=qdepth_b[0])
+            s, out = core(s, inp)
+            acc = acc + out.accepted
+            ys = scan_readback(out, acc, audit=audit,
+                               telemetry=telemetry)
+            return (s, acc), ys
+
+        (st, _acc), ys = lax.scan(
+            body, (st, jnp.zeros((), jnp.int32)),
+            (datas_b, metas_b, counts_b))
+        wd, wm = extract_window(st.log, applied_b[0], replay_slots)
+        out = {k: jax.tree.map(lambda x: x[:, None], v)
+               for k, v in ys.items()}           # [K, 1, ...]
+        out["replay_data"] = wd[None]
+        out["replay_meta"] = wm[None]
+        return _unsqueeze(st), out
+
+    spec_k = P(None, REPLICA_AXIS)
+    out_spec = dict(scal=spec_k, peer_acked=spec_k,
+                    replay_data=P(REPLICA_AXIS),
+                    replay_meta=P(REPLICA_AXIS))
+    if audit:
+        out_spec.update(audit_start=spec_k, audit_digest=spec_k,
+                        audit_term=spec_k, audit_commit=spec_k)
+    if telemetry:
+        out_spec["telemetry"] = spec_k
+    mapped = _shard_map(
+        per_device, mesh=mesh,
+        in_specs=(P(REPLICA_AXIS), P(None, REPLICA_AXIS),
+                  P(None, REPLICA_AXIS), P(None, REPLICA_AXIS),
+                  P(REPLICA_AXIS), P(REPLICA_AXIS), P(REPLICA_AXIS)),
+        out_specs=(P(REPLICA_AXIS), out_spec))
+    return jax.jit(mapped, donate_argnums=(0,) if donate else ())
+
+
 def build_spmd_burst(cfg: LogConfig, n_replicas: int, mesh: Mesh, *,
                      use_pallas: bool = False, interpret: bool = False,
                      donate: bool = True, fanout: str = "gather",

@@ -44,13 +44,17 @@ from rdma_paxos_tpu.proxy.proxy import (
     PendingEvent, ProxyServer, ReplayEngine, spec_send_refused_dirty)
 from rdma_paxos_tpu.proxy.stablestore import (
     HardState, StableStore, atomic_write)
+from rdma_paxos_tpu.runtime.hostpath import plan_segment
 from rdma_paxos_tpu.runtime.sim import SimCluster
 from rdma_paxos_tpu.runtime.timers import ElectionTimer
 from rdma_paxos_tpu.utils.debug import ReplicaLog, StepTimer
 from rdma_paxos_tpu.utils.codec import fragment
 
 
-def conn_origin(conn_id: int) -> int:
+def conn_origin(conn_id):
+    """Origin replica/host encoded in a connection id (scalar
+    or elementwise on numpy columns) — the ONE place the
+    encoding lives."""
     return conn_id >> 24
 
 
@@ -120,8 +124,16 @@ class ClusterDriver:
                  leases: bool = True,
                  lease_opts: Optional[Dict] = None,
                  series_capacity: int = 1280,
-                 metrics_port: Optional[int] = None):
+                 metrics_port: Optional[int] = None,
+                 scan: bool = False):
         self.cfg = cfg
+        # scan=True engages the engine's device-resident K-window scan
+        # tier on the burst path: one consolidated minimal readback
+        # (scalars + in-dispatch replay rows) per K fused steps. The
+        # flag lives on the cluster and is runtime-mutable
+        # (driver.cluster.scan) — the host_path A/B flips it between
+        # rounds; scan-off runs compile no scan programs.
+        self._scan = bool(scan)
         self.sync_period = sync_period
         self._workdir = workdir
         # observability: one registry + trace ring + span recorder per
@@ -351,7 +363,7 @@ class ClusterDriver:
         to serve a multi-group ShardedCluster through the same loop)."""
         return SimCluster(cfg, n_replicas, group_size, mode=mode,
                           fanout=fanout, audit=audit,
-                          telemetry=telemetry)
+                          telemetry=telemetry, scan=self._scan)
 
     def _wire_repair(self) -> None:
         """Single-group driver: repair installs ride
@@ -555,15 +567,19 @@ class ClusterDriver:
             self.repair.drive()
 
     def _pump_submitq(self) -> None:
-        """Move intake rows into the engine's pending queues. Holds the
-        engine's host lock too: the pipelined readback thread requeues
-        ring-full shortfalls into the same lists concurrently."""
+        """Move intake rows into the engine's pending queues — ONE
+        locked extend per replica (batched intake, no per-entry
+        Python). Holds the engine's host lock too: the pipelined
+        readback thread requeues ring-full shortfalls into the same
+        lists concurrently."""
         with self._lock, self.cluster._host_lock:
             for r in range(self.R):
-                for etype, conn, frag, seq in self._submitq[r]:
-                    self.cluster.submit(r, frag, EntryType(etype),
-                                        conn=conn, req_id=seq)
-                self._submitq[r].clear()
+                q = self._submitq[r]
+                if q:
+                    self.cluster.submit_many(
+                        r, [(etype, conn, seq, frag)
+                            for etype, conn, frag, seq in q])
+                    q.clear()
 
     def step(self) -> Dict:
         """One host-loop iteration (public for deterministic tests).
@@ -1397,7 +1413,13 @@ class ClusterDriver:
         n = len(stream)
         if rt.replay_cursor >= n:
             return
-        new = stream[rt.replay_cursor:]
+        self._phase_prof.start("apply_replay_ack")
+        # the engine's decode left the new entries as COLUMNAR batches
+        # (hostpath.ReplayBatch): the replay/ack sweep below touches
+        # Python O(1) per window, not O(1) per entry
+        segs = (stream.segments_from(rt.replay_cursor)
+                if hasattr(stream, "segments_from")
+                else [stream[rt.replay_cursor:]])
         rt.replay_cursor = n
         if rt.store is not None:
             # frames were assembled vectorized during the window decode
@@ -1412,34 +1434,23 @@ class ClusterDriver:
         # reset_app rebuilds it
         replaying = rt.replay is not None and not rt.app_dirty
         own_max = -1
-        run_conn, run_buf = -1, []
+        n_replayed = 0
 
-        def flush_run():
-            nonlocal run_conn, run_buf
-            if run_conn >= 0 and run_buf:
-                rt.replay.apply(int(EntryType.SEND), run_conn,
-                                b"".join(run_buf))
-            run_conn, run_buf = -1, []
+        def own_of(conns, _gens):
+            return conn_origin(conns) == r
 
-        for etype, conn, req, payload in new:
-            if conn_origin(conn) != r:
-                if not replaying:
-                    continue
-                # coalesce consecutive same-connection SENDs (a client
-                # event fragments into a consecutive run): one loopback
-                # write per run — byte-stream identical for the app
-                if etype == int(EntryType.SEND):
-                    if conn != run_conn:
-                        flush_run()
-                        run_conn = conn
-                    run_buf.append(payload)
-                else:
-                    flush_run()
+        for seg in segs:
+            seg_max, ops, n_rem = plan_segment(seg, own_of,
+                                               want_ops=replaying)
+            own_max = max(own_max, seg_max)
+            n_replayed += n_rem
+            if replaying:
+                # remote SEND runs arrive coalesced per connection
+                # (one loopback write per run — byte-stream identical
+                # for the app); CONNECT/CLOSE apply individually
+                for etype, conn, payload in ops:
                     rt.replay.apply(etype, conn, payload)
-            else:
-                own_max = req
         if replaying:
-            flush_run()
             rt.replay.drain_responses()
         if rt.store is not None:
             # The WRITE precedes the ack (store_record runs inside the
@@ -1455,11 +1466,9 @@ class ClusterDriver:
             if now - rt.last_sync > self.sync_period:
                 rt.store.sync()
                 rt.last_sync = now
-        if replaying and new:
-            n_replayed = sum(1 for e in new if conn_origin(e[1]) != r)
-            if n_replayed:
-                self.obs.metrics.inc("replayed_entries_total",
-                                     n_replayed, replica=r)
+        if replaying and n_replayed:
+            self.obs.metrics.inc("replayed_entries_total",
+                                 n_replayed, replica=r)
         if own_max >= 0:
             # ack release by sequence: every own-origin entry carries
             # the fragment seq in req_id (monotone in commit order), so
@@ -1484,6 +1493,7 @@ class ClusterDriver:
                                       submit_seq=own_max)
                 self.obs.spans.ack_release(r, own_max)
             self._phase_prof.stop("ack_release")
+        self._phase_prof.stop("apply_replay_ack")
 
     # ------------------------------------------------------------------
     # lifecycle

@@ -36,8 +36,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from rdma_paxos_tpu.config import LogConfig
-from rdma_paxos_tpu.consensus.log import (
-    EntryType, M_CONN, M_GEN, M_LEN, M_REQID, M_TYPE, META_W)
+from rdma_paxos_tpu.consensus.log import EntryType, META_W
 from rdma_paxos_tpu.consensus.step import StepInput, fetch_window
 from rdma_paxos_tpu.parallel.mesh import (
     REPLICA_AXIS, build_spmd_step, stack_states)
@@ -87,6 +86,9 @@ class HostReplicaDriver:
         # one jitted burst builder (lazily built): the scan length
         # follows the [K, ...] input shape, so jit specializes per K
         self._burst = None
+        # the K-window scan tier (lazily built; RP_SCAN=1 daemons):
+        # fused steps + consolidated readback + local replay window
+        self._scan = None
         self._ksharding = NamedSharding(self.mesh, P(None, REPLICA_AXIS))
 
         # HOST-LOCAL window fetch: reads THIS replica's log shard only —
@@ -226,29 +228,16 @@ class HostReplicaDriver:
     def _pack_batch(self, batch, data: np.ndarray, meta: np.ndarray,
                     gen: int) -> int:
         """Fill one [B, ...] data/meta pair from (etype, conn, req,
-        payload) rows — the single packing used by steps AND bursts.
-        Zero-copy: payload bytes land straight in a u8 view of the
-        staging row (no per-entry pad + frombuffer + word copy).
-        Returns the number of rows written (the caller's dirty count;
-        rows are assumed pre-zeroed)."""
+        payload) rows — the single packing used by steps AND bursts,
+        delegated to the shared vectorized host data plane
+        (``hostpath.pack_window``: one payload join + one scatter per
+        window; all three drivers pack through the one batched
+        implementation). Returns the number of rows written (the
+        caller's dirty count; rows are assumed pre-zeroed)."""
+        from rdma_paxos_tpu.runtime.hostpath import pack_window
         du8 = data.view(np.uint8).reshape(data.shape[0], -1)
-        n = 0
-        for i, (etype, conn, req, payload) in enumerate(
-                batch[:data.shape[0]]):
-            ln = len(payload)
-            if ln > self.cfg.slot_bytes:
-                raise ValueError("payload exceeds slot capacity; "
-                                 "fragment first")
-            if ln:
-                du8[i, :ln] = np.frombuffer(payload, np.uint8)
-            row = meta[i]
-            row[M_TYPE] = etype
-            row[M_CONN] = conn
-            row[M_REQID] = req
-            row[M_LEN] = ln
-            row[M_GEN] = gen
-            n += 1
-        return n
+        return pack_window(du8, meta, list(batch)[:data.shape[0]],
+                           self.cfg.slot_bytes, gen=gen)
 
     def step(self, **kw) -> Dict[str, np.ndarray]:
         """One collective protocol step; every host must call this in the
@@ -356,6 +345,86 @@ class HostReplicaDriver:
                 res["audit_commit" if k == "commit" else k] = (
                     np.asarray(local[0].data[:, 0]) if local else None)
         return res
+
+    def _scan_fn(self):
+        if self._scan is None:
+            from rdma_paxos_tpu.parallel.mesh import build_spmd_scan
+            self._scan = build_spmd_scan(
+                self.cfg, self.R, self.mesh,
+                replay_slots=self.cfg.window_slots,
+                fanout=self._fanout, audit=self._audit,
+                use_pallas=jax.default_backend() == "tpu")
+        return self._scan
+
+    def step_scan(self, K: int,
+                  batches: Sequence[Sequence[Tuple[int, int, int,
+                                                   bytes]]] = (),
+                  apply_done: int = 0, gen: int = 0,
+                  queue_depth: int = 0
+                  ) -> Tuple[Dict[str, np.ndarray],
+                             Tuple[np.ndarray, np.ndarray]]:
+        """The K-window scan tier of :meth:`step_burst`: K fused
+        protocol steps whose readback is ONE consolidated scalar
+        matrix — plus this replica's replay window (``window_slots``
+        committed rows from ``apply_done`` on, read from the POST-scan
+        log inside the same dispatch), so the daemon's apply loop
+        needs no per-window ``fetch_local_window`` dispatches for
+        entries the scan already staged. Same collective-schedule
+        contract as bursts: every host calls this in the same
+        iteration with the same K. Returns ``(res, (wdata, wmeta))``;
+        ``res`` matches :meth:`step_burst`'s (``accepted`` summed,
+        audit windows per fused step when compiled)."""
+        assert K > 0, K
+        cfg, B = self.cfg, self.cfg.batch_slots
+        st = self._kstage.get(K)
+        if st is None:
+            st = self._kstage[K] = dict(
+                data=np.zeros((K, B, cfg.slot_words), np.int32),
+                meta=np.zeros((K, B, META_W), np.int32),
+                dirty=[0] * K)
+        data, meta, dirty = st["data"], st["meta"], st["dirty"]
+        for k, n in enumerate(dirty):
+            if n:
+                data[k, :n] = 0
+                meta[k, :n] = 0
+                dirty[k] = 0
+        count = np.zeros((K,), np.int32)
+        for k, batch in enumerate(list(batches)[:K]):
+            dirty[k] = self._pack_batch(batch, data[k], meta[k], gen)
+            count[k] = min(len(batch), B)
+        fn = self._scan_fn()
+        pm = self._global_from_local(np.ones(self.R, np.int32), fill=1)
+        ap = self._global_from_local(np.asarray(apply_done, np.int32))
+        qd = self._global_from_local(np.asarray(queue_depth, np.int32))
+        self.state, outs = fn(self.state, self._kglobal(data),
+                              self._kglobal(meta),
+                              self._kglobal(count), pm, ap, qd)
+
+        def local_of(arr, axis):
+            sh = [s for s in arr.addressable_shards
+                  if (s.index[axis].start or 0) == self.me]
+            return sh[0].data if sh else None
+
+        from rdma_paxos_tpu.consensus.step import SCAN_KEYS
+        scal = local_of(outs["scal"], 1)        # [K, 1, NS]
+        res: Dict[str, np.ndarray] = {}
+        if scal is not None:
+            row = np.asarray(scal[-1, 0])
+            for i, k in enumerate(SCAN_KEYS):
+                res[k] = row[i]
+        else:
+            res = {k: None for k in SCAN_KEYS}
+        if self._audit and scal is not None:
+            for k in ("audit_start", "audit_digest", "audit_term",
+                      "audit_commit"):
+                loc = local_of(outs[k], 1)      # [K, 1, ...]
+                res[k] = (np.asarray(loc[:, 0]) if loc is not None
+                          else None)
+        wd = local_of(outs["replay_data"], 0)   # [1, W, sw]
+        wm = local_of(outs["replay_meta"], 0)
+        rows = (np.asarray(wd[0]) if wd is not None else None,
+                np.asarray(wm[0]) if wm is not None else None)
+        return res, rows
 
     def rebase(self, delta: int) -> None:
         """Apply the coordinated i32-offset rollover to this host's

@@ -31,8 +31,9 @@ import numpy as np
 from rdma_paxos_tpu.config import (
     ClusterConfig, LogConfig, MAX_BURST_K, REBASE_STALL_STEPS,
     TimeoutConfig)
-from rdma_paxos_tpu.consensus.log import (
-    EntryType, M_CONN, M_GEN, M_LEN, M_REQID, M_TYPE)
+from rdma_paxos_tpu.consensus.log import EntryType, M_GIDX
+from rdma_paxos_tpu.runtime import hostpath
+from rdma_paxos_tpu.runtime.driver import conn_origin
 from rdma_paxos_tpu.consensus.state import Role
 from rdma_paxos_tpu.obs import default as obs_default, trace as obs_trace
 from rdma_paxos_tpu.obs.metrics import LATENCY_BUCKETS_S
@@ -210,6 +211,18 @@ class NodeDaemon:
     REBASE_STALL_STEPS = REBASE_STALL_STEPS
 
     @property
+    def scan_enabled(self) -> bool:
+        """RP_SCAN=1 routes burst iterations through the K-window scan
+        tier (``HostReplicaDriver.step_scan``): same fused protocol
+        steps, but the readback is one consolidated scalar matrix plus
+        this replica's replay window staged INSIDE the dispatch — the
+        per-window ``fetch_local_window`` dispatches disappear. Like
+        RP_BURST, the env must MATCH on every host (program schedule
+        is collective); requires bursts (and their psum gate)."""
+        return (self.burst_enabled
+                and os.environ.get("RP_SCAN") == "1")
+
+    @property
     def burst_enabled(self) -> bool:
         """Bursts amortize per-DISPATCH overhead — dominant on real TPU
         hosts (device launch / tunnel latency per program), negligible
@@ -246,7 +259,10 @@ class NodeDaemon:
         leader, nothing appends) — so the multi-second multi-process
         compile never lands inside a client-visible drain. No-op when
         bursts are disabled for this backend."""
-        if self.burst_enabled:
+        if self.scan_enabled:
+            self.hd.step_scan(self.BURST_K, [], apply_done=self.applied,
+                              gen=self.gen)
+        elif self.burst_enabled:
             self.hd.step_burst(self.BURST_K, [], apply_done=self.applied,
                                gen=self.gen)
 
@@ -333,6 +349,7 @@ class NodeDaemon:
         # moment traffic exists — the single-step path serves only
         # idle heartbeats and election iterations. The decision derives
         # ONLY from the gathered hint, so every host agrees.
+        scan_rows = None            # (wd, wm) staged by the scan tier
         if k_needed >= 1:
             # ONE fixed burst tier: every distinct K is a separate
             # multi-process shard_map compile (~seconds, and the
@@ -357,10 +374,18 @@ class NodeDaemon:
             _t0 = _t.monotonic()
             prof.stop("host_encode")
             prof.start("device_dispatch")
-            res = self.hd.step_burst(K, batches,
-                                     apply_done=self.applied,
-                                     gen=self.gen,
-                                     queue_depth=qdepth)
+            if self.scan_enabled:
+                # K-window scan tier: this replica's replay window
+                # rides the dispatch — consumed by the apply loop
+                # below before any standalone fetch
+                res, scan_rows = self.hd.step_scan(
+                    K, batches, apply_done=self.applied,
+                    gen=self.gen, queue_depth=qdepth)
+            else:
+                res = self.hd.step_burst(K, batches,
+                                         apply_done=self.applied,
+                                         gen=self.gen,
+                                         queue_depth=qdepth)
             prof.stop("device_dispatch")
             if os.environ.get("RP_BURST_DEBUG"):
                 self.log.info_wtime(
@@ -422,19 +447,34 @@ class NodeDaemon:
         if res["hb_seen"] or self._is_leader:
             self.timer.beat()
 
-        # window fetch only when commit advanced — host-local (reads our
-        # own log shard), so hosts may loop it independently: a burst
-        # can commit up to K*batch_slots entries in one dispatch, so
-        # drain window-by-window until caught up
+        # window drain only when commit advanced — the scan tier's
+        # staged rows serve the first window with ZERO extra
+        # dispatches; any remainder falls back to the host-local
+        # fetch (reads our own log shard, loops independently): a
+        # burst can commit up to K*batch_slots entries in one
+        # dispatch, so drain window-by-window until caught up
         commit = int(res["commit"])
         progressed = False
         releases = []
         released_upto = -1
         prof.start("apply")
-        from rdma_paxos_tpu.consensus.log import M_GIDX
+
+        def own_of(conns, gens):
+            # "our own event" means THIS incarnation's (M_GEN column
+            # matches our generation): its app thread already consumed
+            # the bytes live — ack it. An entry from a previous
+            # incarnation of this host is replayed like a remote one:
+            # the rebuilt app has never seen it.
+            return ((conn_origin(conns) == self.host_id)
+                    & (gens == self.gen))
+
         while self.applied < commit and not self.needs_recovery:
             n = min(commit - self.applied, self.cfg.window_slots)
-            wd, wm = self.hd.fetch_local_window(self.applied)
+            if scan_rows is not None and scan_rows[0] is not None:
+                wd, wm = scan_rows      # staged at this apply cursor
+                scan_rows = None
+            else:
+                wd, wm = self.hd.fetch_local_window(self.applied)
             if int(wm[0, M_GIDX]) != self.applied:
                 # our slot was recycled (forced pruning left this host
                 # behind): recycled bytes must never reach the app —
@@ -446,34 +486,28 @@ class NodeDaemon:
                     "recovery required" % self.applied)
                 break
             progressed = True
-            for j in range(n):
-                etype = int(wm[j, M_TYPE])
-                if etype in (int(EntryType.CONNECT), int(EntryType.SEND),
-                             int(EntryType.CLOSE)):
-                    conn = int(wm[j, M_CONN])
-                    req = int(wm[j, M_REQID])
-                    ln = int(wm[j, M_LEN])
-                    payload = wd[j].astype("<i4").tobytes()[:ln]
-                    self.store.append(bytes([etype])
-                                      + conn.to_bytes(4, "little")
-                                      + payload)
-                    # "our own event" means THIS incarnation's (M_GEN
-                    # column matches our generation): its app thread
-                    # already consumed the bytes live — ack it. An entry
-                    # from a previous incarnation of this host is
-                    # replayed like a remote one: the rebuilt app has
-                    # never seen it.
-                    if ((conn >> 24) == self.host_id
-                            and int(wm[j, M_GEN]) == self.gen):
-                        with self._lock:
-                            while (self.inflight
-                                   and self.inflight[0][1] <= req):
-                                ev, _ = self.inflight.popleft()
-                                releases.append(ev)
-                        released_upto = max(released_upto, req)
-                    elif self.replay is not None and not self.app_dirty:
-                        # dirty app: persist only — replay resumes after
-                        # the app is rebuilt from the committed store
+            # vectorized window decode + batched persist/replay/ack
+            # (the shared host data plane): one framed-store append,
+            # one replay plan, one ack-frontier pop per window
+            batch = hostpath.decode_batch(wm, wd, n)
+            if batch is not None:
+                self.store.append_framed(batch.frames())
+                own = own_of(batch.conns, batch.gens)
+                own_max, ops = hostpath.replay_plan(
+                    batch, own,
+                    want_ops=(self.replay is not None
+                              and not self.app_dirty))
+                if own_max >= 0:
+                    with self._lock:
+                        while (self.inflight
+                               and self.inflight[0][1] <= own_max):
+                            ev, _ = self.inflight.popleft()
+                            releases.append(ev)
+                    released_upto = max(released_upto, own_max)
+                if self.replay is not None and not self.app_dirty:
+                    # dirty app: persist only — replay resumes after
+                    # the app is rebuilt from the committed store
+                    for etype, conn, payload in ops:
                         self.replay.apply(etype, conn, payload)
             self.applied += n
         prof.stop("apply")

@@ -71,6 +71,7 @@ import numpy as np
 
 from rdma_paxos_tpu.consensus.snapshot import (
     SnapshotVerifyError, install_snapshot, recover_vote, take_snapshot)
+from rdma_paxos_tpu.runtime.hostpath import stream_copy as _stream_copy
 
 QUARANTINED = "quarantined"
 PROBATION = "probation"
@@ -468,11 +469,12 @@ class RepairController:
                     min_verified=self.min_verified)
                 if self._sharded:
                     c.applied[g, r] = snap.index
-                    c.replayed[g][r] = list(c.replayed[g][donor])
+                    c.replayed[g][r] = _stream_copy(
+                        c.replayed[g][donor])
                     c.frames[g][r] = []
                 else:
                     c.applied[r] = snap.index
-                    c.replayed[r] = list(c.replayed[donor])
+                    c.replayed[r] = _stream_copy(c.replayed[donor])
                     c.frames[r] = []
             snap_index = snap.index
             # the verified chain may have been truncated from below

@@ -51,6 +51,7 @@ from rdma_paxos_tpu.obs.health import make_snapshot
 from rdma_paxos_tpu.obs.metrics import LATENCY_BUCKETS_S
 from rdma_paxos_tpu.proxy.proxy import PendingEvent
 from rdma_paxos_tpu.runtime.driver import ClusterDriver, conn_origin
+from rdma_paxos_tpu.runtime.hostpath import plan_segment
 from rdma_paxos_tpu.runtime.timers import GroupStepTimer
 from rdma_paxos_tpu.shard.cluster import ShardedCluster
 from rdma_paxos_tpu.shard.router import KeyRouter
@@ -140,7 +141,8 @@ class ShardedClusterDriver(ClusterDriver):
         return ShardedCluster(cfg, n_replicas, self.G,
                               router=self._router, fanout=fanout,
                               group_size=group_size, audit=audit,
-                              mesh=self._mesh, telemetry=telemetry)
+                              mesh=self._mesh, telemetry=telemetry,
+                              scan=self._scan)
 
     def _wire_repair(self) -> None:
         """Sharded driver: repair uses the controller's ENGINE-level
@@ -272,14 +274,21 @@ class ShardedClusterDriver(ClusterDriver):
         with self._lock, self.cluster._host_lock:
             views = self._group_views
             for r in range(self.R):
+                if not self._submitq[r]:
+                    continue
+                # demux the intake batch per group, then ONE locked
+                # extend per (group, leader) — batched intake, no
+                # per-entry Python. The group's CURRENT leader takes
+                # the append; if leadership vanished since enqueue the
+                # rows land on a non-leader and are dropped by design
+                # — the leadership-change sweep fails their waiters
+                per_g: Dict[int, list] = {}
                 for g, etype, conn, frag, seq in self._submitq[r]:
-                    # the group's CURRENT leader takes the append; if
-                    # leadership vanished since enqueue the row lands
-                    # on a non-leader and is dropped by design — the
-                    # leadership-change sweep fails its waiter
+                    per_g.setdefault(g, []).append(
+                        (etype, conn, seq, frag))
+                for g, rows in per_g.items():
                     q = views[g] if views[g] >= 0 else 0
-                    self.cluster.submit(g, q, frag, EntryType(etype),
-                                        conn=conn, req_id=seq)
+                    self.cluster.submit_many(g, q, rows)
                 self._submitq[r].clear()
 
     # ------------------------------------------------------------------
@@ -461,13 +470,22 @@ class ShardedClusterDriver(ClusterDriver):
         progressed = False
         releases: list = []
         replaying = rt.replay is not None and not rt.app_dirty
+
+        def own_of(conns, _gens):
+            return conn_origin(conns) == r
+
+        self._phase_prof.start("apply_replay_ack")
         for g in range(self.G):
             stream = c.replayed[g][r]
             n = len(stream)
             cur = self._replay_cursor[r][g]
             if cur >= n:
                 continue
-            new = stream[cur:]
+            # columnar batch consumption — Python O(1) per decoded
+            # window (see ClusterDriver._apply_new_entries)
+            segs = (stream.segments_from(cur)
+                    if hasattr(stream, "segments_from")
+                    else [stream[cur:]])
             self._replay_cursor[r][g] = n
             progressed = True
             if rt.store is not None:
@@ -477,31 +495,13 @@ class ShardedClusterDriver(ClusterDriver):
                     for b in blobs:
                         rt.store.append_framed(b)
             own_max = -1
-            run_conn, run_buf = -1, []
-
-            def flush_run():
-                nonlocal run_conn, run_buf
-                if run_conn >= 0 and run_buf:
-                    rt.replay.apply(int(EntryType.SEND), run_conn,
-                                    b"".join(run_buf))
-                run_conn, run_buf = -1, []
-
-            for etype, conn, req, payload in new:
-                if conn_origin(conn) != r:
-                    if not replaying:
-                        continue
-                    if etype == int(EntryType.SEND):
-                        if conn != run_conn:
-                            flush_run()
-                            run_conn = conn
-                        run_buf.append(payload)
-                    else:
-                        flush_run()
+            for seg in segs:
+                seg_max, ops, _n_rem = plan_segment(
+                    seg, own_of, want_ops=replaying)
+                own_max = max(own_max, seg_max)
+                if replaying:
+                    for etype, conn, payload in ops:
                         rt.replay.apply(etype, conn, payload)
-                else:
-                    own_max = req
-            if replaying:
-                flush_run()
             if own_max >= 0:
                 self._phase_prof.start("ack_release")
                 with self._lock:
@@ -515,6 +515,7 @@ class ShardedClusterDriver(ClusterDriver):
                 self.obs.spans.ack_release(self._span_rep(g, r),
                                            own_max)
                 self._phase_prof.stop("ack_release")
+        self._phase_prof.stop("apply_replay_ack")
         if progressed and replaying:
             rt.replay.drain_responses()
         if progressed and rt.store is not None:

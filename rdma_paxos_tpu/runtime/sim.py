@@ -24,10 +24,13 @@ from rdma_paxos_tpu.config import LogConfig, REBASE_STALL_STEPS
 from rdma_paxos_tpu.consensus.log import (
     EntryType, M_CONN, M_GIDX, M_LEN, M_REQID, M_TYPE, META_W)
 from rdma_paxos_tpu.consensus.state import Role
-from rdma_paxos_tpu.consensus.step import StepInput, fetch_window
+from rdma_paxos_tpu.consensus.step import (
+    SCAN_KEYS, StepInput, fetch_window)
 from rdma_paxos_tpu.parallel.mesh import (
-    build_sim_burst, build_sim_step, build_spmd_burst, build_spmd_step,
-    make_replica_mesh, stack_states)
+    build_sim_burst, build_sim_scan, build_sim_step, build_spmd_burst,
+    build_spmd_scan, build_spmd_step, make_replica_mesh, stack_states)
+from rdma_paxos_tpu.runtime import hostpath
+from rdma_paxos_tpu.runtime.hostpath import LazyReplayStream
 
 
 # Compiled steps are shared across ALL cluster engines (same static
@@ -112,6 +115,20 @@ def run_redigest(cluster, buf_row, lo: int, hi: int, *, group: int,
     return done
 
 
+def cap_scan_tiers(cluster, K: int) -> None:
+    """Validate and cap an engine's fused-dispatch tier set at ``K``
+    (the benches' ``--scan K`` contract, held in ONE place next to
+    ``K_TIERS``): K must be >= 2 — the smallest fused tier — and the
+    burst/scan sizing then picks the smallest capped tier covering
+    the backlog as usual."""
+    K = int(K)
+    if K < 2:
+        raise ValueError(
+            "scan K must be >= 2 (the smallest fused tier)")
+    cluster.K_TIERS = (tuple(t for t in cluster.K_TIERS if t <= K)
+                       or cluster.K_TIERS[:1])
+
+
 def require_drained(tickets, site: str) -> None:
     """Serial-path rule: a fused ``step()``/``step_burst()`` while
     dispatches are in flight would finish out of FIFO order AND mutate
@@ -158,29 +175,20 @@ def rebase_delta_of(heads: Sequence[int], n_slots: int) -> int:
 def decode_window(wm: np.ndarray, wd: np.ndarray, n: int,
                   replayed: List, frames: Optional[List],
                   collect_frames: bool) -> None:
-    """Replay frontier rule: vectorized decode of ``n`` fetched
-    entries — one contiguous byte view + one column read per field
-    (per-entry scalar conversions dominated the replay path at high
-    rates) — appending client entries to ``replayed`` and, when a
-    consumer opted in, the store-ready framed blob to ``frames``."""
-    types = wm[:n, M_TYPE]
-    client = ((types >= int(EntryType.CONNECT))
-              & (types <= int(EntryType.CLOSE)))
-    idxs = np.nonzero(client)[0]
-    if not idxs.size:
+    """Replay frontier rule: batched decode of ``n`` fetched entries
+    (``hostpath.decode_batch`` — one compacted payload blob + cumsum
+    offset table per window, zero per-entry bytes objects), appended
+    as ONE columnar batch to the lazy ``replayed`` stream and, when a
+    consumer opted in, as the store-ready framed blob to ``frames``.
+    The single decode implementation for both engines AND both fetch
+    paths (the standalone replay fetch and the scan tier's in-dispatch
+    replay rows)."""
+    batch = hostpath.decode_batch(wm, wd, n)
+    if batch is None:
         return
-    conns = wm[:n, M_CONN]
-    reqs = wm[:n, M_REQID]
-    lens = wm[:n, M_LEN]
-    raw = np.ascontiguousarray(wd[:n]).view(np.uint8).reshape(n, -1)
-    row = raw.shape[1]
-    buf = raw.tobytes()
-    for j in idxs:
-        o = int(j) * row
-        replayed.append((int(types[j]), int(conns[j]), int(reqs[j]),
-                         buf[o:o + int(lens[j])]))
+    hostpath.extend_stream(replayed, batch)
     if collect_frames:
-        frames.append(assemble_frames(types, conns, lens, raw, idxs))
+        frames.append(batch.frames())
 
 
 class StepTicket:
@@ -193,15 +201,20 @@ class StepTicket:
     ``finish(begin_*())`` — the pipelined driver simply keeps more
     than one ticket in flight."""
 
-    __slots__ = ("kind", "out", "taken", "timeouts", "K", "bufs")
+    __slots__ = ("kind", "out", "taken", "timeouts", "K", "bufs",
+                 "applied0")
 
-    def __init__(self, kind: str, out, taken, timeouts, K: int, bufs):
-        self.kind = kind          # "step" | "burst"
+    def __init__(self, kind: str, out, taken, timeouts, K: int, bufs,
+                 applied0=None):
+        self.kind = kind          # "step" | "burst" | "scan"
         self.out = out            # device output pytree (futures)
         self.taken = taken        # per-replica (or [g][r]) popped rows
         self.timeouts = timeouts
         self.K = K
         self.bufs = bufs          # staging buffer set (pool-owned)
+        # scan tier: the host apply cursors the dispatch staged its
+        # replay window at (the readback rows start here)
+        self.applied0 = applied0
 
 
 class StagingPool:
@@ -249,40 +262,30 @@ def pack_rows(bufs: dict, idx: tuple, take: Sequence[Tuple],
               slot_bytes: int) -> None:
     """Zero-copy entry packing: write (etype, conn, req, payload) rows
     straight into the staging buffers at ``idx`` (e.g. ``(r,)`` or
-    ``(k, g, r)``) — the single packing rule for both engines."""
-    du8 = bufs["data_u8"][idx]
-    mt = bufs["meta"][idx]
-    for i, (t, conn, req, payload) in enumerate(take):
-        ln = len(payload)
-        if ln > slot_bytes:
-            raise ValueError("payload exceeds slot capacity; "
-                             "fragment first")
-        if ln:
-            du8[i, :ln] = np.frombuffer(payload, np.uint8)
-        row = mt[i]
-        row[M_TYPE] = t
-        row[M_CONN] = conn
-        row[M_REQID] = req
-        row[M_LEN] = ln
+    ``(k, g, r)``) — the single packing rule for both engines, now one
+    ``hostpath.pack_window`` batch pass per window (one payload join +
+    one scatter + four column writes instead of a per-entry loop)."""
+    hostpath.pack_window(bufs["data_u8"][idx], bufs["meta"][idx],
+                         take, slot_bytes)
 
 
 def assemble_frames(types, conns, lens, raw, idxs) -> bytes:
     """Store-ready framed blob for the client entries at ``idxs`` of a
     decoded window: ``([u32 len][u8 etype][u32 conn][payload])*``,
-    assembled in two numpy passes (fill + ragged masked gather) — zero
-    per-record Python on the store path. ONE implementation shared by
-    SimCluster and ShardedCluster so the byte format can never drift
-    between the engines (the G=1 parity contract)."""
+    built by ``hostpath.frames_from_cols`` — headers and payload
+    scattered over a precomputed offset table into ONE output
+    allocation (byte-golden against the previous two-pass masked
+    gather; pinned by tests/test_hostpath.py). ONE implementation
+    shared by SimCluster and ShardedCluster so the byte format can
+    never drift between the engines (the G=1 parity contract)."""
     row = raw.shape[1]
-    cl = lens[idxs].astype(np.uint32)
-    mat = np.zeros((idxs.size, 9 + row), np.uint8)
-    mat[:, 0:4] = (cl + 5).astype("<u4")[:, None].view(np.uint8)
-    mat[:, 4] = types[idxs]
-    mat[:, 5:9] = conns[idxs].astype("<i4")[:, None].view(np.uint8)
-    mat[:, 9:] = raw[idxs]
-    keep = (np.arange(9 + row, dtype=np.uint32)[None]
-            < (9 + cl)[:, None])
-    return mat[keep].tobytes()
+    cl = np.minimum(lens[idxs].astype(np.int64), row)
+    keep = np.arange(row, dtype=np.int64) < cl[:, None]
+    blob = raw[idxs][keep].tobytes()
+    offs = np.zeros(idxs.size + 1, np.int64)
+    np.cumsum(cl, out=offs[1:])
+    return hostpath.frames_from_cols(types[idxs], conns[idxs], cl,
+                                     blob, offs)
 
 
 class SimCluster:
@@ -298,8 +301,18 @@ class SimCluster:
                  interpret: bool = False,
                  fanout: str = "gather", stable_fast_path: bool = True,
                  audit: bool = False, flight_capacity: int = 64,
-                 telemetry: bool = False):
+                 telemetry: bool = False, scan: bool = False):
         self.cfg = cfg
+        # device-resident K-window scan tier (hostpath PR): with
+        # scan=True, begin_burst dispatches the fused-scan program —
+        # same protocol computation as the burst, but the readback is
+        # ONE consolidated minimal transfer (scalar matrix + in-
+        # dispatch replay rows) instead of per-field stacks plus a
+        # separate fetch dispatch. Mutable at runtime (A/B benches
+        # flip it); scan-off clusters never build a scan program, so
+        # their STEP_CACHE keys are untouched (tests pin it).
+        self.scan = bool(scan)
+        self.scan_dispatches = 0
         self.R = n_replicas
         self.group_size = group_size or n_replicas
         self._mode = mode
@@ -383,8 +396,10 @@ class SimCluster:
         self.max_inflight_dispatches = 0
         self.last: Optional[Dict[str, np.ndarray]] = None
         # (type, conn_id, req_id, payload) per replica, in apply order
-        self.replayed: List[List[Tuple[int, int, int, bytes]]] = [
-            [] for _ in range(n_replicas)]
+        # — columnar LazyReplayStream batches on the hot path, legacy
+        # tuple view on demand (tests/models/recovery)
+        self.replayed: List[LazyReplayStream] = [
+            LazyReplayStream() for _ in range(n_replicas)]
         # store-ready framed blobs (([u32 len][etype][conn][payload])*)
         # built VECTORIZED during the window decode — the driver hands
         # them to StableStore.append_framed untouched. Only produced
@@ -461,6 +476,16 @@ class SimCluster:
         with self._host_lock:
             self.pending[replica].append(
                 (int(etype), conn, req_id, payload))
+
+    def submit_many(self, replica: int,
+                    entries: Sequence[Tuple[int, int, int, bytes]]
+                    ) -> None:
+        """Queue a whole intake batch of ``(etype, conn, req_id,
+        payload)`` rows in one locked extend — the drivers' batched
+        intake (a per-entry ``submit`` loop was a measurable share of
+        the pump under full windows)."""
+        with self._host_lock:
+            self.pending[replica].extend(entries)
 
     def partition(self, groups: Sequence[Sequence[int]]) -> None:
         """Split the cluster: replicas hear only same-group peers."""
@@ -646,7 +671,8 @@ class SimCluster:
                           cfg.slot_bytes)
             for k in range(K):
                 count[k, r] = max(0, min(n - k * B, B))
-        fn = self._burst_fn(K)
+        scan = self.scan
+        fn = self._scan_fn(K) if scan else self._burst_fn(K)
         if prof is not None:
             prof.stop("host_encode")
             prof.start("device_dispatch")
@@ -656,7 +682,11 @@ class SimCluster:
                 jnp.asarray(bufs["meta"]), jnp.asarray(count),
                 jnp.asarray(mask), jnp.asarray(applied),
                 jnp.asarray(qdepth))
-            ticket = StepTicket("burst", outs, taken, (), K, bufs)
+            ticket = StepTicket("scan" if scan else "burst", outs,
+                                taken, (), K, bufs,
+                                applied0=applied if scan else None)
+            if scan:
+                self.scan_dispatches += 1
             self._tickets.append(ticket)
             self.inflight_dispatches += 1
             self.max_inflight_dispatches = max(
@@ -682,10 +712,19 @@ class SimCluster:
         prof = self.profiler
         out = ticket.out
         burst = ticket.kind == "burst"
+        scan = ticket.kind == "scan"
         if prof is not None:
             prof.sync(out)              # fenced device_sync (opt-in)
             prof.start("quorum_wait")
-        if burst:
+        if scan:
+            # consolidated minimal readback: ONE scalar matrix (final
+            # step's row; ``accepted`` is cumulative in-program) plus
+            # peer_acked — the replay rows are consumed lazily below
+            scal = np.asarray(out["scal"])[-1]           # [R, NS]
+            res = {k: scal[:, i] for i, k in enumerate(SCAN_KEYS)
+                   if k in self.RES_KEYS}
+            res["peer_acked"] = np.asarray(out["peer_acked"])[-1]
+        elif burst:
             res = {k: np.asarray(getattr(out, k))[-1]
                    for k in self.RES_KEYS if k != "accepted"}
             acc = np.asarray(out.accepted).sum(axis=0)       # [R]
@@ -698,13 +737,17 @@ class SimCluster:
         if self._audit:
             # ingest BEFORE _maybe_rebase: the emitted indices are raw
             # (pre-rollover), consistent with the current rebased_total
-            if burst:
+            if burst or scan:
                 # each fused step emitted its own digest window: ingest
                 # them in order so the tiling property (no gaps) holds
-                a_s = np.asarray(out.audit_start)      # [K, R]
-                a_d = np.asarray(out.audit_digest)     # [K, R, W]
-                a_t = np.asarray(out.audit_term)       # [K, R, W]
-                a_c = np.asarray(out.commit)           # [K, R]
+                get = (out.__getitem__ if scan
+                       else lambda k: getattr(out, "commit"
+                                              if k == "audit_commit"
+                                              else k))
+                a_s = np.asarray(get("audit_start"))   # [K, R]
+                a_d = np.asarray(get("audit_digest"))  # [K, R, W]
+                a_t = np.asarray(get("audit_term"))    # [K, R, W]
+                a_c = np.asarray(get("audit_commit"))  # [K, R]
                 for k in range(a_s.shape[0]):
                     self._ingest_audit(a_s[k], a_d[k], a_t[k], a_c[k])
                 res["audit_start"] = a_s[-1]
@@ -724,9 +767,10 @@ class SimCluster:
             # pipelined driver is the readback thread (finish runs
             # there), so telemetry never rides the dispatch path
             from rdma_paxos_tpu.obs import device as _device
-            tv = np.asarray(out.telemetry, dtype=np.int64)
-            res["telemetry"] = (_device.reduce_steps(tv) if burst
-                                else tv)
+            tv = np.asarray(out["telemetry"] if scan
+                            else out.telemetry, dtype=np.int64)
+            res["telemetry"] = (_device.reduce_steps(tv)
+                                if burst or scan else tv)
             _device.accumulate(self.device_counters, res["telemetry"])
             _device.ingest(self.obs, res["telemetry"])
         # ring-full backpressure / deposition: the appended set is a
@@ -741,7 +785,9 @@ class SimCluster:
                     requeue_shortfall(self.pending[r], take, acc_r)
         if prof is not None:
             prof.start("apply")
-        self._replay_committed(res)
+        self._replay_committed(
+            res, scan_rows=((out["replay_data"], out["replay_meta"],
+                             ticket.applied0) if scan else None))
         if prof is not None:
             prof.stop("apply")
         if self._audit:
@@ -766,7 +812,7 @@ class SimCluster:
             self.leases.observe(self, res)
         if self.reads is not None:
             self.reads.drain(self)
-        if burst:
+        if burst or scan:
             B = self.cfg.batch_slots
             self._staging.release(ticket.bufs, [
                 ((k, r), min(B, len(t) - k * B))
@@ -805,13 +851,47 @@ class SimCluster:
             self._STEP_CACHE[key] = fn
         return fn
 
+    def _scan_slots(self, K: int) -> int:
+        """The scan tier's staged replay width: a K-step scan advances
+        commit by at most ``K * batch_slots``, so a small-K dispatch
+        never pays the full replay window's extract/transfer (the
+        fallback fetch covers a host that fell further behind)."""
+        return min(self._replay_W,
+                   max(K * self.cfg.batch_slots,
+                       self.cfg.window_slots))
+
+    def _scan_fn(self, K: int):
+        # the K-window scan tier compiles under its own distinct
+        # "scan"-marked cache keys — scan-off clusters' key sets (and
+        # programs) are bit-identical to the pre-scan ones, exactly
+        # the audit=/telemetry= guard discipline (tests pin it)
+        key = (self.cfg, self.R, self._mode, self._use_pallas,
+               self._interpret, self._fanout, "scan", K,
+               self._scan_slots(K)) \
+            + (("audit",) if self._audit else ()) \
+            + (("telemetry",) if self._telemetry else ())
+        fn = self._STEP_CACHE.get(key)
+        if fn is None:
+            kw = dict(replay_slots=self._scan_slots(K),
+                      use_pallas=self._use_pallas,
+                      interpret=self._interpret, fanout=self._fanout,
+                      audit=self._audit, telemetry=self._telemetry)
+            if self._mode == "spmd":
+                fn = build_spmd_scan(self.cfg, self.R, self.mesh, **kw)
+            else:
+                fn = build_sim_scan(self.cfg, self.R, **kw)
+            self._STEP_CACHE[key] = fn
+        return fn
+
     def step_burst(self) -> Dict[str, np.ndarray]:
         """Drain the pending queues through up to ``max(K_TIERS)`` fused
         protocol steps in ONE device dispatch (multi-step driver mode —
         the host-side analog of the reference's busy commit loop). No
         election timeouts fire inside the burst; the caller must only
         burst while a leader is known. Returns the final step's outputs
-        (``accepted`` aggregated over the burst)."""
+        (``accepted`` aggregated over the burst). With ``scan=True``
+        the dispatch rides the K-window scan tier (same step outputs,
+        consolidated readback + in-dispatch replay rows)."""
         require_drained(self._tickets, "step_burst")
         return self.finish(self.begin_burst())
 
@@ -858,12 +938,15 @@ class SimCluster:
         pm = jnp.asarray(self.peer_mask)
         ap = jnp.zeros((R,), jnp.int32)
         for K in (tiers if tiers is not None else self.K_TIERS):
-            fn = self._burst_fn(K)
-            st = jax.tree.map(lambda x: x.copy(), self.state)
-            fn(st, jnp.zeros((K, R, B, cfg.slot_words), jnp.int32),
-               jnp.zeros((K, R, B, META_W), jnp.int32),
-               jnp.zeros((K, R), jnp.int32), pm, ap,
-               jnp.zeros((R,), jnp.int32))
+            fns = [self._burst_fn(K)]
+            if self.scan:
+                fns.append(self._scan_fn(K))
+            for fn in fns:
+                st = jax.tree.map(lambda x: x.copy(), self.state)
+                fn(st, jnp.zeros((K, R, B, cfg.slot_words), jnp.int32),
+                   jnp.zeros((K, R, B, META_W), jnp.int32),
+                   jnp.zeros((K, R), jnp.int32), pm, ap,
+                   jnp.zeros((R,), jnp.int32))
 
     def step(self, timeouts: Sequence[int] = ()) -> Dict[str, np.ndarray]:
         require_drained(self._tickets, "step")
@@ -1032,13 +1115,44 @@ class SimCluster:
             self.obs.trace.record(_trace.REBASE_APPLIED, delta=delta,
                                   rebases=self.rebases)
 
-    def _replay_committed(self, res) -> None:
+    def _replay_committed(self, res, scan_rows=None) -> None:
         """Host apply loop: fetch newly committed entries from the device
         log and 'replay' them (tests record them; the real driver hands
         them to the proxy) — apply_committed_entries analog
         (dare_server.c:1815-1974). All replicas' windows ride ONE device
-        dispatch per sweep."""
+        dispatch per sweep.
+
+        ``scan_rows`` (the K-window scan tier): ``(wd_fut, wm_fut,
+        applied0)`` replay rows that rode the scan dispatch itself,
+        starting at the pre-dispatch apply cursors — consumed FIRST, so
+        a scan step whose commit delta fits the staged window pays
+        ZERO standalone fetch dispatches; any remainder falls through
+        to the fetch loop below (identical decode → identical
+        streams)."""
         W = self._replay_W
+        if scan_rows is not None:
+            wd_fut, wm_fut, applied0 = scan_rows
+            staged = int(wm_fut.shape[-2])     # K-sized, <= replay_W
+            wd_all = wm_all = None
+            for r in range(self.R):
+                if (r in self._wedged or r in self.need_recovery):
+                    continue
+                commit = int(res["commit"][r])
+                off = int(self.applied[r]) - int(applied0[r])
+                n = int(min(commit - self.applied[r], staged - off))
+                if n <= 0 or off < 0:
+                    continue
+                if wd_all is None:      # lazy: transfer only if used
+                    wd_all = np.asarray(wd_fut)
+                    wm_all = np.asarray(wm_fut)
+                wd = wd_all[r, off:off + n]
+                wm = wm_all[r, off:off + n]
+                if int(wm[0, M_GIDX]) != self.applied[r]:
+                    self.need_recovery.add(r)       # slot recycled
+                    continue
+                decode_window(wm, wd, n, self.replayed[r],
+                              self.frames[r], self.collect_frames)
+                self.applied[r] += n
         # Force-pruned laggards: when the ring no longer PHYSICALLY holds
         # entry `applied` (a newer entry recycled its slot — possible
         # once forced pruning let appends run ahead of a wedged member's

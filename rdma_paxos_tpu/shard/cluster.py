@@ -61,13 +61,16 @@ from rdma_paxos_tpu.config import LogConfig, REBASE_STALL_STEPS
 from rdma_paxos_tpu.consensus.log import (
     EntryType, Log, M_CONN, M_GIDX, M_LEN, M_REQID, M_TYPE, META_W)
 from rdma_paxos_tpu.consensus.state import Role
-from rdma_paxos_tpu.consensus.step import StepInput, fetch_window
+from rdma_paxos_tpu.consensus.step import (
+    SCAN_KEYS, StepInput, fetch_window)
 from rdma_paxos_tpu.parallel.mesh import (
     GROUP_AXIS, REPLICA_AXIS, build_mesh_2d, build_sim_group_burst,
-    build_sim_group_step, build_spmd_group_burst, build_spmd_group_step,
-    group_sharding, stack_group_states)
+    build_sim_group_scan, build_sim_group_step, build_spmd_group_burst,
+    build_spmd_group_scan, build_spmd_group_step, group_sharding,
+    stack_group_states)
+from rdma_paxos_tpu.runtime.hostpath import LazyReplayStream
 from rdma_paxos_tpu.runtime.sim import (
-    STEP_CACHE, SimCluster, StagingPool, StepTicket, assemble_frames,
+    STEP_CACHE, SimCluster, StagingPool, StepTicket,
     clamp_burst_take, decode_window, pack_rows, rebase_delta_of,
     requeue_shortfall, require_drained)
 from rdma_paxos_tpu.shard.router import KeyRouter
@@ -108,10 +111,18 @@ class ShardedCluster:
                  stable_fast_path: bool = True,
                  group_size: Optional[int] = None,
                  audit: bool = False, flight_capacity: int = 64,
-                 mesh=None, telemetry: bool = False):
+                 mesh=None, telemetry: bool = False,
+                 scan: bool = False):
         if n_groups < 1:
             raise ValueError("n_groups must be >= 1")
         self.cfg = cfg
+        # device-resident K-window scan tier (see SimCluster.scan):
+        # burst dispatches ride the fused-scan program with ONE
+        # consolidated readback + in-dispatch replay rows for all
+        # G x R logs. Mutable at runtime; scan-off clusters build no
+        # scan programs (cache keys untouched).
+        self.scan = bool(scan)
+        self.scan_dispatches = 0
         self.R = int(n_replicas)
         self.G = int(n_groups)
         self.group_size = group_size or n_replicas
@@ -215,8 +226,8 @@ class ShardedCluster:
         self.inflight_dispatches = 0
         self.max_inflight_dispatches = 0
         self._dispatch_clock = 0
-        self.replayed: List[List[list]] = [
-            [[] for _ in range(R)] for _ in range(G)]
+        self.replayed: List[List[LazyReplayStream]] = [
+            [LazyReplayStream() for _ in range(R)] for _ in range(G)]
         self.last: Optional[Dict[str, np.ndarray]] = None
         self.need_recovery: set = set()     # {(g, r)} force-pruned past
         self._wedged: set = set()           # {(g, r)} frozen apply
@@ -266,6 +277,14 @@ class ShardedCluster:
         with self._host_lock:
             self.pending[group][replica].append(
                 (int(etype), conn, req_id, payload))
+
+    def submit_many(self, group: int, replica: int,
+                    entries: Sequence[Tuple[int, int, int, bytes]]
+                    ) -> None:
+        """Batched intake for one group's replica — see
+        ``SimCluster.submit_many``."""
+        with self._host_lock:
+            self.pending[group][replica].extend(entries)
 
     def partition(self, group: int,
                   groups_of_replicas: Sequence[Sequence[int]]) -> None:
@@ -386,6 +405,35 @@ class ShardedCluster:
             STEP_CACHE[key] = fn
         return fn, key
 
+    def _scan_slots(self, K: int) -> int:
+        """K-sized staged replay width — see SimCluster._scan_slots."""
+        return min(self._replay_W,
+                   max(K * self.cfg.batch_slots,
+                       self.cfg.window_slots))
+
+    def _scan_fn(self, K: int):
+        # distinct "group-scan"-marked cache keys: scan-off clusters'
+        # key sets and programs are untouched (the audit=/telemetry=
+        # guard discipline; pinned by test)
+        key = (self.cfg, self.R, self._mode, self._mesh_key,
+               self._use_pallas, self._interpret, self._fanout,
+               "group-scan", K, self._scan_slots(K)) \
+            + (("audit",) if self._audit else ()) \
+            + (("telemetry",) if self._telemetry else ())
+        fn = STEP_CACHE.get(key)
+        if fn is None:
+            kw = dict(replay_slots=self._scan_slots(K),
+                      use_pallas=self._use_pallas,
+                      interpret=self._interpret, fanout=self._fanout,
+                      audit=self._audit, telemetry=self._telemetry)
+            if self.mesh is not None:
+                fn = build_spmd_group_scan(self.cfg, self.R,
+                                           self.mesh, **kw)
+            else:
+                fn = build_sim_group_scan(self.cfg, self.R, **kw)
+            STEP_CACHE[key] = fn
+        return fn, key
+
     def prewarm(self, tiers: Optional[Sequence[int]] = None) -> None:
         """Compile every step variant (and burst tier) up front on
         copies of the live state. One compile covers ALL groups — the
@@ -407,12 +455,16 @@ class ShardedCluster:
         pm = jnp.asarray(self.peer_mask)
         ap = jnp.zeros((G, R), jnp.int32)
         for K in (tiers if tiers is not None else self.K_TIERS):
-            fn, _ = self._burst_fn(K)
-            st = jax.tree.map(lambda x: x.copy(), self.state)
-            fn(st, jnp.zeros((K, G, R, B, cfg.slot_words), jnp.int32),
-               jnp.zeros((K, G, R, B, META_W), jnp.int32),
-               jnp.zeros((K, G, R), jnp.int32), pm, ap,
-               jnp.zeros((G, R), jnp.int32))
+            fns = [self._burst_fn(K)]
+            if self.scan:
+                fns.append(self._scan_fn(K))
+            for fn, _ in fns:
+                st = jax.tree.map(lambda x: x.copy(), self.state)
+                fn(st,
+                   jnp.zeros((K, G, R, B, cfg.slot_words), jnp.int32),
+                   jnp.zeros((K, G, R, B, META_W), jnp.int32),
+                   jnp.zeros((K, G, R), jnp.int32), pm, ap,
+                   jnp.zeros((G, R), jnp.int32))
 
     def begin_step(self, timeouts: TimeoutsLike = (),
                    take_batch: bool = True) -> StepTicket:
@@ -536,7 +588,8 @@ class ShardedCluster:
                               cfg.slot_bytes)
                 for k in range(K):
                     count[k, g, r] = max(0, min(n - k * B, B))
-        fn, key = self._burst_fn(K)
+        scan = self.scan
+        fn, key = self._scan_fn(K) if scan else self._burst_fn(K)
         if prof is not None:
             prof.stop("host_encode")
             prof.start("device_dispatch")
@@ -546,7 +599,11 @@ class ShardedCluster:
                 jnp.asarray(bufs["meta"]), jnp.asarray(count),
                 jnp.asarray(mask), jnp.asarray(applied),
                 jnp.asarray(qdepth))
-            ticket = StepTicket("burst", outs, taken, {}, K, bufs)
+            ticket = StepTicket("scan" if scan else "burst", outs,
+                                taken, {}, K, bufs,
+                                applied0=applied if scan else None)
+            if scan:
+                self.scan_dispatches += 1
             self._tickets.append(ticket)
             self.inflight_dispatches += 1
             self.max_inflight_dispatches = max(
@@ -572,10 +629,17 @@ class ShardedCluster:
         prof = self.profiler
         out = ticket.out
         burst = ticket.kind == "burst"
+        scan = ticket.kind == "scan"
         if prof is not None:
             prof.sync(out)              # fenced device_sync (opt-in)
             prof.start("quorum_wait")
-        if burst:
+        if scan:
+            # consolidated minimal readback (see SimCluster.finish)
+            scal = np.asarray(out["scal"])[-1]       # [G, R, NS]
+            res = {k: scal[..., i] for i, k in enumerate(SCAN_KEYS)
+                   if k in _RES_KEYS}
+            res["peer_acked"] = np.asarray(out["peer_acked"])[-1]
+        elif burst:
             res = {k: np.asarray(getattr(out, k))[-1]
                    for k in _RES_KEYS if k != "accepted"}
             acc = np.asarray(out.accepted).sum(axis=0)       # [G, R]
@@ -585,11 +649,15 @@ class ShardedCluster:
         if prof is not None:
             prof.stop("quorum_wait")
         if self._audit:
-            if burst:
-                a_s = np.asarray(out.audit_start)      # [K, G, R]
-                a_d = np.asarray(out.audit_digest)     # [K, G, R, W]
-                a_t = np.asarray(out.audit_term)       # [K, G, R, W]
-                a_c = np.asarray(out.commit)           # [K, G, R]
+            if burst or scan:
+                get = (out.__getitem__ if scan
+                       else lambda k: getattr(out, "commit"
+                                              if k == "audit_commit"
+                                              else k))
+                a_s = np.asarray(get("audit_start"))   # [K, G, R]
+                a_d = np.asarray(get("audit_digest"))  # [K, G, R, W]
+                a_t = np.asarray(get("audit_term"))    # [K, G, R, W]
+                a_c = np.asarray(get("audit_commit"))  # [K, G, R]
                 for k in range(a_s.shape[0]):
                     self._ingest_audit(a_s[k], a_d[k], a_t[k], a_c[k])
                 res["audit_start"] = a_s[-1]
@@ -608,9 +676,10 @@ class ShardedCluster:
             # out_specs gather already collected every chip's vector
             # into the global [.., G, R, T_N] array
             from rdma_paxos_tpu.obs import device as _device
-            tv = np.asarray(out.telemetry, dtype=np.int64)
-            res["telemetry"] = (_device.reduce_steps(tv) if burst
-                                else tv)
+            tv = np.asarray(out["telemetry"] if scan
+                            else out.telemetry, dtype=np.int64)
+            res["telemetry"] = (_device.reduce_steps(tv)
+                                if burst or scan else tv)
             _device.accumulate(self.device_counters, res["telemetry"])
             _device.ingest(self.obs, res["telemetry"])
         with self._host_lock:
@@ -624,7 +693,9 @@ class ShardedCluster:
                                           acc_gr)
         if prof is not None:
             prof.start("apply")
-        self._replay_committed(res)
+        self._replay_committed(
+            res, scan_rows=((out["replay_data"], out["replay_meta"],
+                             ticket.applied0) if scan else None))
         if prof is not None:
             prof.stop("apply")
         if self._audit:
@@ -647,7 +718,7 @@ class ShardedCluster:
             self.leases.observe(self, res)
         if self.reads is not None:
             self.reads.drain(self)
-        if burst:
+        if burst or scan:
             self._staging.release(ticket.bufs, [
                 ((k, g, r), min(B, len(t) - k * B))
                 for g in range(G) for r in range(R)
@@ -686,7 +757,7 @@ class ShardedCluster:
 
     # ---------------- host apply / rebase ----------------
 
-    def _replay_committed(self, res) -> None:
+    def _replay_committed(self, res, scan_rows=None) -> None:
         """Per-group host apply loop — ALL groups' and replicas'
         windows ride ONE fetch dispatch per sweep (the [G, R]-vmapped
         ``fetch_window``). Same integrity rule as ``SimCluster``: a
@@ -695,10 +766,43 @@ class ShardedCluster:
         flag ``(g, r)`` for snapshot recovery and stop replaying.
         Frame assembly and the per-group apply-time histograms
         (``step_phase_us{phase=apply, group=g}``) ride the same decode
-        pass."""
+        pass. ``scan_rows``: the K-window scan tier's in-dispatch
+        replay rows, consumed FIRST (see SimCluster) — a scan whose
+        commit delta fits the staged window pays zero fetch
+        dispatches."""
         import time as _time
         W = self._replay_W
         t_group: Dict[int, int] = {}
+        if scan_rows is not None:
+            wd_fut, wm_fut, applied0 = scan_rows
+            staged = int(wm_fut.shape[-2])     # K-sized, <= replay_W
+            wd_all = wm_all = None
+            for g in range(self.G):
+                for r in range(self.R):
+                    if ((g, r) in self._wedged
+                            or (g, r) in self.need_recovery):
+                        continue
+                    commit = int(res["commit"][g, r])
+                    off = int(self.applied[g, r]) - int(applied0[g, r])
+                    n = int(min(commit - self.applied[g, r],
+                                staged - off))
+                    if n <= 0 or off < 0:
+                        continue
+                    if wd_all is None:  # lazy: transfer only if used
+                        wd_all = np.asarray(wd_fut)
+                        wm_all = np.asarray(wm_fut)
+                    t0 = _time.perf_counter_ns()
+                    wd = wd_all[g, r, off:off + n]
+                    wm = wm_all[g, r, off:off + n]
+                    if int(wm[0, M_GIDX]) != self.applied[g, r]:
+                        self.need_recovery.add((g, r))
+                        continue
+                    decode_window(wm, wd, n, self.replayed[g][r],
+                                  self.frames[g][r],
+                                  self.collect_frames)
+                    self.applied[g, r] += n
+                    t_group[g] = (t_group.get(g, 0)
+                                  + _time.perf_counter_ns() - t0)
         while True:
             todo = [(g, r) for g in range(self.G)
                     for r in range(self.R)

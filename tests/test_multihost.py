@@ -81,6 +81,71 @@ def test_three_process_cluster(tmp_path):
         assert f"HOST{i} OK commit=2 leader=0" in out, out
 
 
+SCAN_WORKER = r"""
+import os, sys
+pid, n, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("XLA_FLAGS", None)    # 1 device per process
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from rdma_paxos_tpu.config import LogConfig
+from rdma_paxos_tpu.consensus.log import EntryType
+from rdma_paxos_tpu.runtime.host import HostReplicaDriver
+from rdma_paxos_tpu.runtime import hostpath
+
+cfg = LogConfig(n_slots=64, slot_bytes=32, window_slots=16, batch_slots=8)
+hd = HostReplicaDriver(cfg, process_id=pid, num_processes=n,
+                       coordinator="127.0.0.1:%s" % port)
+
+res = hd.step(timeout_fired=(pid == 0))
+assert res["term"] == 1, res
+
+# K=2 scan: host 0 feeds one batch per fused step; every host calls
+# the SAME collective in the same iteration (lock-step contract)
+batches = ([[(int(EntryType.SEND), (0 << 24) | 1, 1, b"sc-one")],
+            [(int(EntryType.SEND), (0 << 24) | 1, 2, b"sc-two")]]
+           if pid == 0 else [])
+res, rows = hd.step_scan(2, batches, apply_done=int(res["commit"]))
+# one more (empty) scan so the lazy commit reaches every host; rows
+# are staged at apply_done=1 — the committed client entries arrive in
+# the SAME dispatch, no fetch_local_window needed
+res, (wd, wm) = hd.step_scan(2, [], apply_done=1)
+commit = int(res["commit"])
+assert commit == 3, res
+batch = hostpath.decode_batch(wm, wd, commit - 1)
+assert [t[3] for t in batch.tuples()] == [b"sc-one", b"sc-two"], (
+    batch.tuples())
+assert int(res["accepted"]) == 0          # nothing submitted this scan
+print("HOST%d SCAN OK commit=%d leader=%d" % (pid, commit,
+                                              int(res["leader_id"])),
+      flush=True)
+"""
+
+
+def test_three_process_scan_tier(tmp_path):
+    """The K-window scan tier across REAL process boundaries: fused
+    steps + the consolidated readback + each host's replay window
+    staged inside the one collective dispatch."""
+    port = str(9450 + (os.getpid() % 40))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get(
+        "PYTHONPATH", "")
+    script = tmp_path / "scan_worker.py"
+    script.write_text(SCAN_WORKER)
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), str(i), "3", port],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for i in range(3)]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=170)
+        outs.append(out.decode())
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"host {i} failed:\n{out}"
+        assert f"HOST{i} SCAN OK commit=3 leader=0" in out, out
+
+
 REBASE_WORKER = r"""
 import os, sys
 pid, n, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
