@@ -1,0 +1,52 @@
+"""graftlint — repo-native static analysis for the invariants the
+replication engine's correctness rests on.
+
+Five passes over the source tree (``python -m rdma_paxos_tpu.analysis``):
+
+``jit-purity``       no host-side symbol (obs, threading, wall clock,
+                     unseeded randomness) reachable from the device
+                     modules that run inside jit/shard_map — and the
+                     declared host-pure modules never reach into jax.
+``cache-key``        every builder that stores a compiled program into
+                     STEP_CACHE folds each static flag it reads into
+                     the cache key (the "new flag, forgotten key
+                     component" bug class, closed for all builders).
+``lock-discipline``  every access to a ``# guarded-by:``-annotated
+                     field happens under the declared lock (or is a
+                     justified baseline entry).
+``determinism``      no wall clock / unseeded randomness in the chaos,
+                     replay, and step-domain modules (obs/clock.py is
+                     the single wall anchor).
+``thread-hygiene``   every spawned thread has a stop/join path; HTTP
+                     serving handlers answer errors instead of dying.
+
+Findings are ``Finding(file, line, pass_id, message)``; justified
+exceptions live in ``analysis/baseline.toml`` (one ``[[suppress]]``
+block each, with a reason). The companion runtime sanitizer
+(``analysis/runtime_guard.py``, enabled by ``RP_SANITIZE=1``) turns
+the same ``guarded-by`` declarations into per-access lock-ownership
+assertions at run time.
+"""
+
+from rdma_paxos_tpu.analysis.engine import (  # noqa: F401
+    Finding, PASS_IDS, default_baseline_path, load_baseline,
+    repo_root, run_analysis)
+from rdma_paxos_tpu.analysis.purity import (  # noqa: F401
+    DEVICE_MODULES, HOST_PURE_MODULES, SCAN_PATTERNS)
+
+
+def jit_purity_findings(root=None):
+    """Run ONLY the jit-purity pass (baseline applied) — the single
+    source of truth behind the ``test_jit_safety_scan_*`` tier-1
+    wrappers."""
+    report = run_analysis(root=root, passes=("jit-purity",))
+    return report.findings
+
+
+def assert_jit_purity(root=None) -> None:
+    """Assert-style wrapper for the tier-1 jit-safety tests: raises
+    AssertionError naming every finding if the device/host purity
+    contract is violated anywhere."""
+    findings = jit_purity_findings(root)
+    assert not findings, "jit-purity violations:\n" + "\n".join(
+        str(f) for f in findings)

@@ -1,0 +1,250 @@
+"""jit-purity pass: the device modules stay pure, the host-pure
+modules stay off the accelerator.
+
+The device-module manifest lives HERE, in one place: a PR that adds a
+new compiled surface extends ``DEVICE_MODULES`` once and every rule —
+transitive import provenance, stdlib bans, and the source-pattern scan
+— covers it automatically. This pass is the single source of truth
+behind the six tier-1 ``test_jit_safety_scan_*`` wrappers that used to
+carry six diverging copies of the regex list.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Tuple
+
+from rdma_paxos_tpu.analysis.engine import Finding, SourceTree
+
+PASS_ID = "jit-purity"
+
+# modules whose code runs INSIDE jit/shard_map (compiled surfaces).
+# Everything transitively imported from here lands in a trace.
+DEVICE_MODULES = (
+    "rdma_paxos_tpu/consensus/step.py",
+    "rdma_paxos_tpu/ops/__init__.py",
+    "rdma_paxos_tpu/ops/quorum.py",
+    "rdma_paxos_tpu/parallel/mesh.py",
+)
+
+# no module reachable from a device module may come from these: host
+# orchestration, observability, threads, wall clock, global-state
+# randomness (jax.random is fine — it is seeded and traced).
+FORBIDDEN_DEVICE_IMPORTS = (
+    "rdma_paxos_tpu.obs",
+    "rdma_paxos_tpu.runtime",
+    "rdma_paxos_tpu.chaos",
+    "rdma_paxos_tpu.shard",
+    "rdma_paxos_tpu.proxy",
+    "rdma_paxos_tpu.models",
+    "rdma_paxos_tpu.analysis",
+    "threading",
+    "time",
+    "random",
+    "socket",
+    "subprocess",
+    "http",
+)
+
+# source-pattern scan over the device modules (comments included —
+# an obs call site hiding in dead code is one uncomment away from a
+# cache-key change). The union of the six scattered test lists, deduped.
+SCAN_PATTERNS: Tuple[str, ...] = (
+    r"rdma_paxos_tpu\.obs",
+    # the catch-all from the old test_spans copy: ANY obs.* reference
+    # in a device module is a leak, named submodule or not
+    r"\bobs\.",
+    r"\.metrics\.(inc|set|observe)\b",
+    r"\.trace\.record\b",
+    r"\.spans\.\w+\(",
+    r"\bAuditLedger\b",
+    r"\bFlightRecorder\b",
+    r"\bAlertEngine\b",
+    r"\bProfilerSession\b",
+    r"jax\.profiler",
+    r"\bMetricsRegistry\b",
+    r"runtime\.reads",
+    r"runtime\.repair",
+    r"\bLeaseManager\b",
+    r"\bReadHub\b",
+    r"\breads_served\b",
+    r"\bserving_holder\b",
+    r"\bRepairController\b",
+    r"\binstall_snapshot\b",
+    r"\btake_snapshot\b",
+    r"\bTimeSeriesStore\b",
+    r"\bOpsExporter\b",
+    r"\brender_prometheus\b",
+    r"\bserve_metrics\b",
+    r"\bfleet_view\b",
+    r"\bassemble_bundle\b",
+    r"\bthreading\b",
+)
+
+# host-pure modules: pure host orchestration/data-plane code that must
+# never reach back into the accelerator stack. Each entry: banned
+# import roots (AST-level) + banned source patterns. hostpath.py (the
+# PR 13 vectorized data plane, previously uncovered by any scan test)
+# bans by IMPORT only — its docstring legitimately names jax to forbid
+# it.
+HOST_PURE_MODULES: Dict[str, dict] = {
+    "rdma_paxos_tpu/runtime/hostpath.py": dict(
+        ban_imports=("jax", "jaxlib"),
+        patterns=(r"\bjnp\b", r"shard_map")),
+    "rdma_paxos_tpu/runtime/reads.py": dict(
+        ban_imports=("jax", "jaxlib"),
+        patterns=(r"\bjax\b", r"\bjnp\b", r"shard_map")),
+    "rdma_paxos_tpu/runtime/repair.py": dict(
+        ban_imports=(),
+        patterns=(r"jax\.jit", r"shard_map")),
+    "rdma_paxos_tpu/obs/series.py": dict(
+        ban_imports=("jax", "jaxlib"),
+        patterns=(r"\bjax\b", r"\bjnp\b", r"shard_map")),
+    "rdma_paxos_tpu/obs/export.py": dict(
+        ban_imports=("jax", "jaxlib"),
+        patterns=(r"\bjax\b", r"\bjnp\b", r"shard_map")),
+    "rdma_paxos_tpu/obs/console.py": dict(
+        ban_imports=("jax", "jaxlib"),
+        patterns=(r"\bjax\b", r"\bjnp\b", r"shard_map")),
+}
+
+
+def _forbidden(dotted: str) -> Optional[str]:
+    for p in FORBIDDEN_DEVICE_IMPORTS:
+        if dotted == p or dotted.startswith(p + "."):
+            return p
+    return None
+
+
+def _imports_of(mod) -> List[Tuple[str, int]]:
+    """(dotted target, line) for every import statement in the module,
+    function-level imports included; ``from p import name`` edges
+    cover both ``p`` and — when it resolves to a file — ``p.name``."""
+    out: List[Tuple[str, int]] = []
+    pkg_parts = mod.dotted.split(".")
+    # a module's package: drop the leaf (``__init__`` already dropped)
+    pkg = pkg_parts[:-1]
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out.append((a.name, node.lineno))
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:
+                base = pkg[: len(pkg) - (node.level - 1)]
+                target = ".".join(base + ([node.module]
+                                          if node.module else []))
+            else:
+                target = node.module or ""
+            if target:
+                out.append((target, node.lineno))
+                for a in node.names:
+                    out.append((target + "." + a.name, node.lineno))
+    return out
+
+
+def _closure_findings(tree: SourceTree, root_rel: str) -> List[Finding]:
+    """BFS the package-internal import graph from one device module;
+    flag any reachable forbidden module, naming the import chain."""
+    findings: List[Finding] = []
+    root_mod = tree.module(root_rel)
+    # rel -> (parent rel, import line in parent) for chain rendering
+    seen: Dict[str, Optional[Tuple[str, int]]] = {root_rel: None}
+    reported = set()       # (module, line, forbidden root) dedupe
+    queue = [root_rel]
+    while queue:
+        rel = queue.pop(0)
+        mod = tree.module(rel)
+        for dotted, line in _imports_of(mod):
+            bad = _forbidden(dotted)
+            if bad is not None:
+                if (rel, line, bad) in reported:
+                    continue
+                reported.add((rel, line, bad))
+                # report at the DEVICE module (the actionable site):
+                # for transitive hits, the chain names the path
+                chain = [rel]
+                cur = rel
+                while seen.get(cur) is not None:
+                    cur = seen[cur][0]
+                    chain.append(cur)
+                chain.reverse()
+                first_line = (line if rel == root_rel
+                              else _root_import_line(
+                                  root_mod, tree, chain[1]))
+                findings.append(Finding(
+                    file=root_rel, line=first_line, pass_id=PASS_ID,
+                    message="forbidden host-side module %r (matches "
+                            "%r) reachable from device module via %s "
+                            "(%s:%d)" % (dotted, bad,
+                                         " -> ".join(chain), rel,
+                                         line)))
+                continue
+            sub = tree.rel_of_dotted(dotted)
+            if sub is not None and sub not in seen:
+                seen[sub] = (rel, line)
+                queue.append(sub)
+    return findings
+
+
+def _root_import_line(root_mod, tree: SourceTree, second_rel: str) -> int:
+    """The line in the device module importing the first chain hop."""
+    for dotted, line in _imports_of(root_mod):
+        if tree.rel_of_dotted(dotted) == second_rel:
+            return line
+    return 1
+
+
+def _pattern_findings(tree: SourceTree, rel: str,
+                      patterns) -> List[Finding]:
+    mod = tree.module(rel)
+    out: List[Finding] = []
+    for pat in patterns:
+        rx = re.compile(pat)
+        for i, line in enumerate(mod.lines, 1):
+            if rx.search(line):
+                out.append(Finding(
+                    file=rel, line=i, pass_id=PASS_ID,
+                    message="forbidden source pattern %r: %r" %
+                            (pat, line.strip()[:80])))
+                break     # one finding per pattern per file is enough
+    return out
+
+
+def _host_pure_findings(tree: SourceTree, rel: str,
+                        spec: dict) -> List[Finding]:
+    mod = tree.module(rel)
+    out: List[Finding] = []
+    roots = spec.get("ban_imports", ())
+    if roots:
+        for dotted, line in _imports_of(mod):
+            head = dotted.split(".")[0]
+            if head in roots:
+                out.append(Finding(
+                    file=rel, line=line, pass_id=PASS_ID,
+                    message="host-pure module imports accelerator "
+                            "module %r" % dotted))
+    for pat in spec.get("patterns", ()):
+        rx = re.compile(pat)
+        for i, line in enumerate(mod.lines, 1):
+            if rx.search(line):
+                out.append(Finding(
+                    file=rel, line=i, pass_id=PASS_ID,
+                    message="host-pure module matches accelerator "
+                            "pattern %r: %r" % (pat,
+                                                line.strip()[:80])))
+                break
+    return out
+
+
+def run(tree: SourceTree) -> List[Finding]:
+    findings: List[Finding] = []
+    for rel in DEVICE_MODULES:
+        if not tree.has(rel):
+            continue          # partial fixture trees
+        findings.extend(_closure_findings(tree, rel))
+        findings.extend(_pattern_findings(tree, rel, SCAN_PATTERNS))
+    for rel, spec in HOST_PURE_MODULES.items():
+        if tree.has(rel):
+            findings.extend(_host_pure_findings(tree, rel, spec))
+    return findings

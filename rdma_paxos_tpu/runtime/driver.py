@@ -168,6 +168,7 @@ class ClusterDriver:
         # ReplayEngine.quiesce. Supply probe_fn whenever the app's
         # protocol allows one.
         self.app_snapshot = app_snapshot
+        # guarded-by: _lock [writes]
         self._ckpt_req: Optional[Tuple[int, threading.Event, list]] = None
         # lost-majority step-down (the reference leader SUICIDES after
         # failing to reach a majority, dare_server.c:1213-1217): a
@@ -298,14 +299,19 @@ class ClusterDriver:
         # the stepping thread over cluster.state): (replica, donor,
         # done_event, exception_box) — failures surface to the caller,
         # never kill the loop
+        # guarded-by: _lock [writes]
         self._recover_req = None
         # app-reset requests (mis-speculation quarantine exit), same
         # poll-loop execution discipline: (replica, done_event, box)
+        # guarded-by: _lock [writes]
         self._reset_req = None
         self._lock = threading.Lock()
         # per-replica queues of (etype, conn_id, fragment_bytes, seq)
         self._submitq: List[List[Tuple[int, int, bytes, int]]]
-        self._submitq = [[] for _ in range(n_replicas)]
+        self._submitq = [[] for _ in range(n_replicas)]  # guarded-by: _lock
+        # advisory leader view: written under the lock on the readback
+        # thread; lock-free reads (poll/app threads) tolerate one step
+        # of staleness by design  # guarded-by: _lock [writes]
         self._leader_view = -1
         # stores consume the vectorized frame stream from the decode
         self.cluster.collect_frames = workdir is not None
@@ -529,9 +535,14 @@ class ClusterDriver:
         checkpoint) — they execute on the stepping thread so they never
         race it over cluster state, and only with the dispatch pipeline
         fully drained."""
-        req = self._recover_req
+        # pop each request slot under the lock: the writers
+        # (recover_replica / reset_app / checkpoint_app on caller
+        # threads) publish under it, and an unlocked clear here could
+        # lose a request armed between the read and the None-store
+        # (graftlint lock-discipline rider)
+        with self._lock:
+            req, self._recover_req = self._recover_req, None
         if req is not None:
-            self._recover_req = None
             r, donor, done, box = req
             try:
                 self._do_recover(r, donor)
@@ -539,9 +550,9 @@ class ClusterDriver:
                 box.append(exc)
             finally:
                 done.set()
-        rreq = self._reset_req
+        with self._lock:
+            rreq, self._reset_req = self._reset_req, None
         if rreq is not None:
-            self._reset_req = None
             r, done, box = rreq
             try:
                 self._do_reset_app(r)
@@ -549,9 +560,9 @@ class ClusterDriver:
                 box.append(exc)
             finally:
                 done.set()
-        creq = self._ckpt_req
+        with self._lock:
+            creq, self._ckpt_req = self._ckpt_req, None
         if creq is not None:
-            self._ckpt_req = None
             r, done, box = creq
             try:
                 self._do_checkpoint(r)
@@ -1360,16 +1371,21 @@ class ClusterDriver:
             cur_term = hs[0]
             if hs[1] > vt:
                 vt, vf = hs[1], hs[2]
-        self.cluster.state = install_snapshot(
-            self.cluster.state, r, snap,
-            voted_term=vt, voted_for=vf, cur_term=cur_term,
-            ledger=ledger, min_verified=min_verified)
-        self.cluster.applied[r] = snap.index
-        rt_stream = self.cluster.replayed[r]
-        rrt.replay_cursor = len(rt_stream)
-        # undrained frames predate the snapshot load: appending them to
-        # the freshly loaded store would duplicate history
-        self.cluster.frames[r] = []
+        # state surgery under the engine host lock: recovery runs on
+        # drained serial iterations, but the lock makes the invariant
+        # local — a concurrent submit/begin_* can never observe the
+        # install half-applied (graftlint lock-discipline rider)
+        with self.cluster._host_lock:
+            self.cluster.state = install_snapshot(
+                self.cluster.state, r, snap,
+                voted_term=vt, voted_for=vf, cur_term=cur_term,
+                ledger=ledger, min_verified=min_verified)
+            self.cluster.applied[r] = snap.index
+            rt_stream = self.cluster.replayed[r]
+            rrt.replay_cursor = len(rt_stream)
+            # undrained frames predate the snapshot load: appending
+            # them to the freshly loaded store would duplicate history
+            self.cluster.frames[r] = []
         if rrt.store is not None and snap.store_blob:
             old_len = len(rrt.store)
             rrt.store.reset()
@@ -1530,6 +1546,7 @@ class ClusterDriver:
                         or (self.cluster.reads is not None
                             and self.cluster.reads.pending_count()))
 
+    # holds-lock: _lock
     def _waiter_count(self) -> int:
         """Blocked commit waiters across replicas (caller holds
         ``_lock``); the sharded driver counts its per-group deques."""

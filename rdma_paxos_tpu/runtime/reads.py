@@ -160,11 +160,12 @@ class LeaseManager:
         self.lease_steps = int(lease_steps)
         self.guard_steps = int(guard_steps)
         self.renew_trace_every = max(1, int(renew_trace_every))
+        # guarded-by: _lock
         self._st: List[_LeaseState] = [_LeaseState()
                                        for _ in range(self.G)]
         self._lock = threading.Lock()
-        self._now = 0            # finished-step clock (engine.step_index)
-        self._now_max = 0        # max(step_index, dispatch_clock)
+        self._now = 0        # guarded-by: _lock [writes]
+        self._now_max = 0    # guarded-by: _lock [writes]
         self._obs = None         # refreshed from the engine each observe
         self.grants = 0
         self.renewals = 0
@@ -218,6 +219,7 @@ class LeaseManager:
                 self._observe_group(g, step, leader, lterm, verified,
                                     blocked)
 
+    # holds-lock: _lock
     def _observe_group(self, g: int, step: int, leader: int,
                        term: int, verified: bool,
                        blocked: bool) -> None:
@@ -420,10 +422,16 @@ class ReadHub:
         self.leases = leases
         self.patience_steps = int(patience_steps)
         self._lock = threading.Lock()
+        # guarded-by: _lock [strict]
         self._q: collections.deque = collections.deque()
+        # guarded-by: _lock
         self.served: Dict[str, int] = {PATH_LEASE: 0,
                                        PATH_READ_INDEX: 0}
-        self.failed = 0
+        self.failed = 0    # guarded-by: _lock
+        # runtime lock sanitizer: _q is [strict] — under RP_SANITIZE=1
+        # even READS assert the hub lock (no lock-free read exists)
+        from rdma_paxos_tpu.analysis import runtime_guard
+        runtime_guard.maybe_guard(self, "_lock", __file__)
 
     def submit(self, serve_fn: Optional[Callable] = None, *,
                replica: int, group: int = 0,

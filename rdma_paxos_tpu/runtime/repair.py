@@ -126,6 +126,7 @@ class RepairController:
         self._lock = threading.RLock()
         # (g, r) -> dict(state=, attempts=, next_try=, clean=,
         #                finding=, last_step=)
+        # guarded-by: _lock
         self.states: Dict[Tuple[int, int], dict] = {}
         # deterministic evidence: step-domain events only (no wall
         # clock) so same-seed chaos verdicts embed identical timelines.
@@ -181,6 +182,7 @@ class RepairController:
             pm[:, r] = 0
             pm[r, r] = 1
 
+    # holds-lock: _lock
     def _restore_mask(self, g: int, r: int) -> None:
         # restore hearing to every peer EXCEPT ones this controller
         # still holds — re-opening a link to a second, still-diverged
@@ -306,6 +308,7 @@ class RepairController:
                 except Exception:  # noqa: BLE001 — a failing hook
                     pass           # must never kill the observe pass
 
+    # holds-lock: _lock
     def _quarantine(self, g: int, r: int, finding: dict) -> bool:
         """Returns True when ``(g, r)`` newly entered (or re-entered)
         quarantine this call."""
@@ -371,6 +374,7 @@ class RepairController:
                     repaired.append(key)
         return repaired
 
+    # holds-lock: _lock
     def _donor_candidates(self, g: int, r: int) -> List[int]:
         """Majority-set donor order: never the diverged minority (the
         ledger's implicated set), never another quarantined replica;
@@ -382,6 +386,7 @@ class RepairController:
         cands = [p for p in range(self.R) if p != r and p not in bad]
         return sorted(cands, key=lambda p: (-self._applied(g, p), p))
 
+    # holds-lock: _lock
     def _repair_one(self, key: Tuple[int, int]) -> bool:
         g, r = key
         st = self.states[key]
@@ -566,6 +571,7 @@ class RepairController:
                    indices=pend["indices"])
         return True
 
+    # holds-lock: _lock
     def _readmit(self, key: Tuple[int, int]) -> None:
         g, r = key
         del self.states[key]
@@ -680,6 +686,7 @@ class RepairController:
                     pass           # the alert-evaluating poll loop
         return held
 
+    # holds-lock: _lock
     def _policy_quarantine(self, g: int, r: int,
                            reason: str) -> bool:
         """Quarantine WITHOUT a digest finding (caller holds our

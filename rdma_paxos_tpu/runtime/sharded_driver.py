@@ -113,15 +113,20 @@ class ShardedClusterDriver(ClusterDriver):
         # per-group leader views (the sharded analog of _leader_view;
         # _leader_view itself becomes the ALL-GROUPS-LED aggregate so
         # leader()-polling boot code works unchanged)
+        # guarded-by: _lock [writes]
         self._group_views: List[int] = [-1] * self.G
+        # guarded-by: _lock
         self._conn_group: Dict[int, int] = {}    # conn -> pinned group
+        # guarded-by: _lock
         self._conn_hold: Dict[int, tuple] = {}   # conn -> held CONNECT
         super().__init__(cfg, n_replicas, **kw)
         # (replica, group) commit-waiter FIFOs + replay cursors — the
         # single-group driver's rt.inflight / rt.replay_cursor, demuxed
+        # guarded-by: _lock
         self._inflight_g: List[List[collections.deque]] = [
             [collections.deque() for _ in range(self.G)]
             for _ in range(n_replicas)]
+        # guarded-by: _lock
         self._replay_cursor = [[0] * self.G for _ in range(n_replicas)]
         # per-group jittered STEP-DOMAIN election timers + candidate
         # rotation (group g's first candidate is replica g % R, so
@@ -298,6 +303,7 @@ class ShardedClusterDriver(ClusterDriver):
     def _backlog(self) -> int:
         return max(len(q) for row in self.cluster.pending for q in row)
 
+    # holds-lock: _lock
     def _waiter_count(self) -> int:
         return sum(len(dq) for row in self._inflight_g for dq in row)
 

@@ -354,6 +354,8 @@ class SimCluster:
         # election timer fired (the latency hot path — Phase B statically
         # removed, one fewer collective); compiled lazily on first use
         self._stable_fast_path = stable_fast_path
+        # the donated device-state handle: REBINDING it races the next
+        # dispatch  # guarded-by: _host_lock [writes]
         self.state = stack_states(cfg, n_replicas, self.group_size)
         if mode == "spmd":
             mkey = (cfg, n_replicas, "mesh")
@@ -378,8 +380,12 @@ class SimCluster:
             lambda log, start: fetch_window(
                 log, start, window_slots=self._replay_W)))
         # host bookkeeping
-        self.applied = np.zeros(n_replicas, np.int64)   # host apply cursor
+        # host apply cursor — single-writer: advanced in-place by the
+        # finishing (readback) thread only; whole-array WRITES rebind
+        # under the lock  # guarded-by: _host_lock [writes]
+        self.applied = np.zeros(n_replicas, np.int64)
         self.peer_mask = np.ones((n_replicas, n_replicas), np.int32)
+        # guarded-by: _host_lock
         self.pending: List[List[Tuple[int, int, int, bytes]]] = [
             [] for _ in range(n_replicas)]
         # pipelined dispatch (begin_*/finish): FIFO of in-flight
@@ -389,11 +395,15 @@ class SimCluster:
         # _host_lock guards the host queues (pending/applied/last)
         # against the dispatch-thread/readback-thread split — serial
         # callers pay one uncontended acquire.
+        # guarded-by: _host_lock
         self._tickets: collections.deque = collections.deque()
         self._staging = StagingPool()
         self._host_lock = threading.RLock()
-        self.inflight_dispatches = 0
-        self.max_inflight_dispatches = 0
+        self.inflight_dispatches = 0         # guarded-by: _host_lock
+        self.max_inflight_dispatches = 0     # guarded-by: _host_lock
+        # published by pointer swap under the lock; lock-free READS see
+        # a complete (stale at worst) result dict by design
+        # guarded-by: _host_lock [writes]
         self.last: Optional[Dict[str, np.ndarray]] = None
         # (type, conn_id, req_id, payload) per replica, in apply order
         # — columnar LazyReplayStream batches on the hot path, legacy
@@ -462,6 +472,12 @@ class SimCluster:
         # link model the same per-step randomness twice; serial callers
         # see the two clocks equal at every dispatch.
         self._dispatch_clock = 0
+        # runtime lock sanitizer (analysis/runtime_guard.py): under
+        # RP_SANITIZE=1 the guarded-by declarations above become
+        # per-access lock-ownership assertions — a latent unlocked
+        # mutation fails the test at the exact access. No-op otherwise.
+        from rdma_paxos_tpu.analysis import runtime_guard
+        runtime_guard.maybe_guard(self, "_host_lock", __file__)
 
     # ---------------- client-side API ----------------
 
@@ -551,9 +567,12 @@ class SimCluster:
                 data=np.zeros((K, R, B, cfg.slot_words), np.int32),
                 meta=np.zeros((K, R, B, META_W), np.int32)))
 
+    # holds-lock: _host_lock
     def reserved_appends(self) -> np.ndarray:
         """Per-replica appends dispatched but not yet finished — the
-        pipelined capacity reservation (``end`` has not caught up)."""
+        pipelined capacity reservation (``end`` has not caught up).
+        Callers hold ``_host_lock`` (begin_burst's capacity sizing and
+        the chaos runner's drained-serial room check)."""
         out = np.zeros(self.R, np.int64)
         for t in self._tickets:
             for r in range(self.R):
@@ -1068,6 +1087,7 @@ class SimCluster:
                     min_head=min(heads), heads=heads,
                     steps=self.rebase_stall_steps)
 
+    # holds-lock: _host_lock
     def _maybe_rebase(self, res) -> None:
         """Coordinated i32-offset rollover (LogConfig.rebase_threshold):
         when any end offset crosses the threshold, subtract the minimum

@@ -263,6 +263,14 @@ class ShardedCluster:
         self.collect_frames = False
         self.frames: List[List[List[bytes]]] = [
             [[] for _ in range(R)] for _ in range(G)]
+        # runtime lock sanitizer: the guarded-by declarations live in
+        # runtime/sim.py (the fields are name-shared across both
+        # engines) — under RP_SANITIZE=1 they become lock-ownership
+        # assertions here too. No-op otherwise.
+        from rdma_paxos_tpu.analysis import runtime_guard
+        from rdma_paxos_tpu.runtime import sim as _sim_mod
+        runtime_guard.maybe_guard(self, "_host_lock",
+                                  _sim_mod.__file__, __file__)
 
     # ---------------- client-side API ----------------
 
@@ -349,6 +357,7 @@ class ShardedCluster:
                 data=np.zeros((K, G, R, B, cfg.slot_words), np.int32),
                 meta=np.zeros((K, G, R, B, META_W), np.int32)))
 
+    # holds-lock: _host_lock
     def reserved_appends(self) -> np.ndarray:
         """[G, R] appends dispatched but not yet finished (pipelined
         capacity reservation — same rule as SimCluster)."""
@@ -856,6 +865,7 @@ class ShardedCluster:
                     min_head=min(heads), heads=heads,
                     steps=int(self.rebase_stall_steps[g]))
 
+    # holds-lock: _host_lock
     def _maybe_rebase(self, res) -> None:
         """Per-group coordinated i32-offset rollover: each group whose
         max end crossed ``rebase_threshold`` drops every offset of ITS
@@ -901,10 +911,12 @@ class ShardedCluster:
                                       group=int(g), delta=d,
                                       rebases=int(self.rebases[g]))
 
+    # holds-lock: _host_lock
     def _apply_rebase(self, deltas: np.ndarray) -> None:
         """Elementwise per-group offset subtraction — the grouped form
         of ``consensus.snapshot.rebase_offsets`` (same invariants:
-        delta <= that group's min head, multiple of n_slots)."""
+        delta <= that group's min head, multiple of n_slots). Called
+        from ``_maybe_rebase`` under the host lock."""
         state = self.state
         d_gr = jnp.asarray(deltas.astype(np.int32))[:, None]   # [G, 1]
         d_buf = d_gr[:, :, None]                               # [G, 1, 1]

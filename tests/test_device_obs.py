@@ -315,28 +315,12 @@ def test_telemetry_alert_rules_fire_and_resolve():
 def test_jit_safety_scan_covers_device_module():
     """consensus/step.py, ops/*, and parallel/mesh.py run inside
     jit/shard_map: no obs.device symbol (ProfilerSession, registry
-    ingest, jax.profiler) may be imported there, and no such call-site
-    pattern may appear in their source — the telemetry vector is pure
-    jnp, produced blind and consumed host-side."""
-    import inspect
-    import re
-
-    import rdma_paxos_tpu.consensus.step as smod
-    import rdma_paxos_tpu.ops as ops_pkg
-    import rdma_paxos_tpu.ops.quorum as quorum_mod
-    import rdma_paxos_tpu.parallel.mesh as mesh_mod
-    for mod in (smod, ops_pkg, quorum_mod, mesh_mod):
-        for name, val in vars(mod).items():
-            owner = getattr(val, "__module__", None) or ""
-            assert not str(owner).startswith("rdma_paxos_tpu.obs"), (
-                f"{mod.__name__}.{name} comes from {owner}")
-        src = inspect.getsource(mod)
-        for pat in (r"rdma_paxos_tpu\.obs", r"\bobs\.device\b",
-                    r"ProfilerSession", r"jax\.profiler",
-                    r"MetricsRegistry",
-                    r"\.metrics\.(inc|set|observe)\b",
-                    r"\.trace\.record\b"):
-            assert not re.search(pat, src), (mod.__name__, pat)
+    ingest, jax.profiler) may be reachable there — the telemetry
+    vector is pure jnp, produced blind and consumed host-side.
+    Enforced by the graftlint ``jit-purity`` pass (the deduped
+    ``SCAN_PATTERNS`` union carries this test's former inline list)."""
+    from rdma_paxos_tpu.analysis import assert_jit_purity
+    assert_jit_purity()
 
 
 # ---------------------------------------------------------------------------
