@@ -1,0 +1,73 @@
+"""Seeded arrival-trace generator — offered-load shapes for the
+adaptive-dispatch (governor) benches.
+
+Real traffic is not the constant closed loop the flag-overhead benches
+drive: it is bursty (request storms between idle valleys), diurnal
+(a slow swell and ebb), or steps between regimes (a deploy doubling
+load). A static dispatch geometry is tuned for exactly one point on
+those curves; the governor's claim is that it tracks all of them. The
+traces here make that testable: ``make_trace(shape, ticks, seed=s)``
+returns the per-tick entry arrival counts, bit-identical for a given
+``(shape, ticks, seed, lo, hi)`` — seeded through the string-seeded
+RNG (PYTHONHASHSEED-independent), the ``GroupStepTimer`` discipline —
+so every A/B variant replays the identical offered load and a CI
+smoke re-derives the same trace forever.
+
+Stdlib only (the benches import this before jax config lands).
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import List
+
+SHAPES = ("bursty", "diurnal", "step")
+
+
+def make_trace(shape: str, ticks: int, *, seed: int = 0,
+               lo: int = 0, hi: int = 128,
+               period: int = 0) -> List[int]:
+    """Per-tick arrival counts for one offered-load shape.
+
+    * ``bursty`` — square-wave storms: alternating on/off phases of
+      jittered length; on-phase ticks arrive near ``hi``, off-phase
+      ticks near ``lo`` (idle valleys — where idle quiescence and
+      tier descent earn their keep).
+    * ``diurnal`` — one full sinusoidal swell over the trace (or per
+      ``period`` ticks): the slow ramp that walks the governor up and
+      down the whole ladder.
+    * ``step`` — ``lo``-trickle first half, ``hi`` second half: the
+      regime change (deploy / failover) that tests climb speed.
+
+    Deterministic per ``(shape, ticks, seed, lo, hi, period)``.
+    """
+    if shape not in SHAPES:
+        raise ValueError(f"unknown trace shape {shape!r} "
+                         f"(known: {SHAPES})")
+    ticks = int(ticks)
+    rng = random.Random(f"arrival:{shape}:{seed}:{lo}:{hi}:{period}")
+    out: List[int] = []
+    if shape == "bursty":
+        phase_hi = max(2, (period or max(ticks // 10, 8)) // 2)
+        on = False
+        while len(out) < ticks:
+            length = rng.randint(max(2, phase_hi // 2), phase_hi * 2)
+            for _ in range(min(length, ticks - len(out))):
+                if on:
+                    out.append(max(0, int(hi * rng.uniform(0.7, 1.3))))
+                else:
+                    out.append(int(lo * rng.uniform(0.0, 1.0)))
+            on = not on
+    elif shape == "diurnal":
+        p = period or ticks
+        for t in range(ticks):
+            level = 0.5 - 0.5 * math.cos(2 * math.pi * t / max(p, 1))
+            rate = lo + (hi - lo) * level
+            out.append(max(0, int(rate * rng.uniform(0.9, 1.1))))
+    else:  # step
+        cut = ticks // 2
+        for t in range(ticks):
+            rate = lo if t < cut else hi
+            out.append(max(0, int(rate * rng.uniform(0.9, 1.1))))
+    return out

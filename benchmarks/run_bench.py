@@ -165,6 +165,154 @@ def measure_host_path(cfg=None, *, n_replicas=3, steps=40,
     return out
 
 
+def measure_governor(trace_shape="bursty", cfg=None, *, n_replicas=3,
+                     ticks=400, seed=0, repeats=3, payload=24,
+                     hi=None, scan=False):
+    """The adaptive-dispatch A/B on the engine closed loop: one seeded
+    arrival trace (``benchmarks/arrival_traces.py``) replayed
+    IDENTICALLY through
+
+    * every static geometry on the ladder — the serial single step
+      and each burst tier cap K (each variant dispatches every tick,
+      the driver-poll analog: an idle tick still costs a heartbeat
+      dispatch, which is exactly the idle bias being measured); and
+    * the governed variant — the :class:`DispatchGovernor` picks the
+      tier per tick, skips the dispatch entirely on idle ticks
+      (quiescence), and holds admission for a bounded beat when the
+      window is filling (coalescing).
+
+    Alternating best-of rounds (the shared A/B methodology). Emitted:
+    ``governor_speedup`` = governed committed-ops/s over the BEST
+    single static geometry for this trace, and ``governor_p99_ratio``
+    = governed per-entry commit-latency p99 over that same best
+    static variant's (<= 1.1 acceptance: throughput is never bought
+    with latency). The governed cluster's ``governor_tier`` trace
+    events ride the result (the CI failure artifact)."""
+    import collections as _coll
+    import time as _t
+
+    from benchmarks.arrival_traces import make_trace
+    from rdma_paxos_tpu.config import LogConfig
+    from rdma_paxos_tpu.obs import Observability
+    from rdma_paxos_tpu.runtime.governor import attach_governor
+    from rdma_paxos_tpu.runtime.sim import SimCluster
+
+    if cfg is None:
+        cfg = LogConfig(n_slots=4096, slot_bytes=64, window_slots=256,
+                        batch_slots=64)
+    B = cfg.batch_slots
+    arrivals = make_trace(trace_shape, ticks, seed=seed, lo=0,
+                          hi=(hi or 3 * B))
+    total_entries = sum(arrivals)
+    blob = b"x" * payload
+
+    clusters = {}
+    variants = ["serial"] + [f"burst{k}" for k in SimCluster.K_TIERS]
+    for v in variants + ["governed"]:
+        c = SimCluster(cfg, n_replicas, fanout="psum", scan=scan)
+        c.run_until_elected(0)
+        gov = None
+        if v == "governed":
+            c.obs = Observability()
+            gov = attach_governor(c, obs=c.obs)
+        c.prewarm()
+        clusters[v] = (c, gov)
+
+    def committed(c):
+        return int(c.last["commit"].max()) + c.rebased_total
+
+    def run_round(v):
+        c, gov = clusters[v]
+        base = committed(c)
+        submitted = 0
+        waiting = _coll.deque()    # (abs target index, t_submit, n)
+        lats = []                  # (latency_s, n)
+        coalesce_run = 0
+
+        def harvest():
+            done = committed(c) - base
+            now = _t.perf_counter()
+            while waiting and waiting[0][0] <= done:
+                tgt, ts, n = waiting.popleft()
+                lats.append((now - ts, n))
+
+        def dispatch():
+            nonlocal coalesce_run
+            coalesce_run = 0
+            if v == "serial":
+                c.step()
+            elif v == "governed":
+                d = gov.decision
+                if d.max_k > 1 and len(c.pending[0]):
+                    c.step_burst(max_k=d.max_k)
+                else:
+                    c.step()
+            else:
+                k = int(v[len("burst"):])
+                if len(c.pending[0]):
+                    c.step_burst(max_k=k)
+                else:
+                    c.step()        # idle heartbeat dispatch
+            harvest()
+
+        t0 = _t.perf_counter()
+        for n in arrivals:
+            if n:
+                c.submit_many(0, [(3, 1, 0, blob)] * n)
+                submitted += n
+                waiting.append((submitted, _t.perf_counter(), n))
+            if v == "governed":
+                backlog = len(c.pending[0])
+                if backlog == 0 and not waiting:
+                    continue        # idle quiescence: no dispatch
+                d = gov.decision
+                if (d.coalesce_us > 0 and coalesce_run < 3
+                        and 0 < backlog < d.max_k * B // 2):
+                    coalesce_run += 1
+                    continue        # bounded admission coalesce
+            dispatch()
+        while committed(c) - base < submitted:
+            dispatch()
+        dt = _t.perf_counter() - t0
+        weight = sum(n for _, n in lats)
+        p99 = 0.0
+        if weight:
+            need = 0.99 * weight
+            cum = 0
+            for lat, n in sorted(lats):
+                cum += n
+                if cum >= need:
+                    p99 = lat
+                    break
+        return dict(ops_per_sec=round(submitted / dt, 1),
+                    seconds=round(dt, 4), committed=submitted,
+                    p99_s=round(p99, 6))
+
+    out = {v: dict(ops_per_sec=0.0) for v in variants + ["governed"]}
+    for _ in range(repeats):
+        for v in variants + ["governed"]:
+            row = run_round(v)
+            if row["ops_per_sec"] > out[v]["ops_per_sec"]:
+                out[v] = row
+    best_v = max(variants, key=lambda v: out[v]["ops_per_sec"])
+    gov_row, best = out["governed"], out[best_v]
+    c, gov = clusters["governed"]
+    events = [e.as_dict() for e in c.obs.trace.events()
+              if e.kind.startswith("governor")]
+    return dict(
+        trace=trace_shape, seed=seed, ticks=ticks,
+        entries=total_entries,
+        governed=gov_row, best_static=dict(variant=best_v, **best),
+        all_static={v: out[v] for v in variants},
+        governor=gov.status(),
+        governor_events=events,
+        governor_speedup=round(
+            gov_row["ops_per_sec"]
+            / max(best["ops_per_sec"], 1e-9), 3),
+        governor_p99_ratio=round(
+            gov_row["p99_s"] / max(best["p99_s"], 1e-9), 3))
+
+
 def measure_audit_overhead(cfg=None, **kw):
     """A/B the compiled-step digest chain (``audit=``); the proof is
     the ON cluster's ledger summary — the workload ran digest-checked
@@ -641,6 +789,25 @@ def main():
                          "clock anchors")
     ap.add_argument("--profile-secs", type=float, default=60.0,
                     help="hard bound on the --profile capture")
+    ap.add_argument("--governor", action="store_true",
+                    help="adaptive-dispatch A/B (standalone — no e2e "
+                         "stack): replay seeded arrival traces "
+                         "(bursty/diurnal/step) through the governed "
+                         "engine vs every static geometry, emitting "
+                         "governor_speedup (>= 1.15x target on the "
+                         "bursty trace) and governor_p99_ratio "
+                         "(<= 1.1: latency never traded away) rows")
+    ap.add_argument("--governor-ticks", type=int, default=400,
+                    help="trace length in ticks (CI smoke uses a "
+                         "small value)")
+    ap.add_argument("--governor-shapes", default="bursty,diurnal,step",
+                    help="comma-separated trace shapes to run")
+    ap.add_argument("--governor-seed", type=int, default=0)
+    ap.add_argument("--governor-repeats", type=int, default=3)
+    ap.add_argument("--governor-trace", default=None, metavar="PATH",
+                    help="write the governed runs' decision trace "
+                         "(governor_* events) as JSON — the CI "
+                         "failure artifact")
     ap.add_argument("--serve-metrics", nargs="?", const=0,
                     default=None, type=int, metavar="PORT",
                     help="serve the live ops endpoints (/metrics "
@@ -676,6 +843,47 @@ def main():
     import jax
     if os.environ.get("RP_BENCH_CPU", "1") == "1":
         jax.config.update("jax_platforms", "cpu")
+
+    if args.governor:
+        # standalone mode (like plain --groups): the governor A/B is
+        # an engine-closed-loop measurement — no app/proxy stack
+        import json as _json
+
+        from benchmarks.reporting import emit
+        all_events = {}
+        speedups = {}
+        for shape in [s.strip() for s in
+                      args.governor_shapes.split(",") if s.strip()]:
+            gv = measure_governor(shape, ticks=args.governor_ticks,
+                                  seed=args.governor_seed,
+                                  repeats=args.governor_repeats)
+            best = gv["best_static"]
+            print(f"governor [{shape}]: "
+                  f"{gv['governed']['ops_per_sec']} ops/s governed vs "
+                  f"{best['ops_per_sec']} ops/s best static "
+                  f"({best['variant']}) -> {gv['governor_speedup']}x, "
+                  f"p99 {gv['governed']['p99_s'] * 1e3:.2f}ms vs "
+                  f"{best['p99_s'] * 1e3:.2f}ms "
+                  f"({gv['governor_p99_ratio']}x)")
+            detail = {k: v for k, v in gv.items()
+                      if k != "governor_events"}
+            emit("governor_speedup", gv["governor_speedup"], "x",
+                 detail=detail, json_path=args.json)
+            emit("governor_p99_ratio", gv["governor_p99_ratio"], "x",
+                 detail=dict(trace=shape,
+                             governed_p99_s=gv["governed"]["p99_s"],
+                             best_static_p99_s=best["p99_s"]),
+                 json_path=args.json)
+            all_events[shape] = gv["governor_events"]
+            speedups[shape] = gv["governor_speedup"]
+        if args.governor_trace:
+            with open(args.governor_trace, "w") as f:
+                _json.dump(dict(ticks=args.governor_ticks,
+                                seed=args.governor_seed,
+                                speedups=speedups,
+                                events=all_events), f, indent=2)
+            print(f"governor decision trace: {args.governor_trace}")
+        return
 
     from rdma_paxos_tpu.config import LogConfig, TimeoutConfig
     from rdma_paxos_tpu.runtime.driver import ClusterDriver

@@ -33,6 +33,10 @@ SCOPE = (
     "rdma_paxos_tpu/runtime/sim.py",
     "rdma_paxos_tpu/runtime/timers.py",
     "rdma_paxos_tpu/runtime/hostpath.py",
+    # governor decisions must be pure step-domain functions of the
+    # observed inputs (chaos verdicts with a governor attached stay
+    # bit-reproducible) — no wall clock, no unseeded randomness
+    "rdma_paxos_tpu/runtime/governor.py",
 )
 
 # attribute references (calls or not — a ``clock=time.monotonic``

@@ -57,6 +57,7 @@ LOCK_MODULES = (
     "rdma_paxos_tpu/runtime/sharded_driver.py",
     "rdma_paxos_tpu/runtime/repair.py",
     "rdma_paxos_tpu/runtime/reads.py",
+    "rdma_paxos_tpu/runtime/governor.py",
     "rdma_paxos_tpu/shard/cluster.py",
 )
 

@@ -95,6 +95,12 @@ HOST_PURE_MODULES: Dict[str, dict] = {
     "rdma_paxos_tpu/runtime/reads.py": dict(
         ban_imports=("jax", "jaxlib"),
         patterns=(r"\bjax\b", r"\bjnp\b", r"shard_map")),
+    # the adaptive dispatch governor: pure host control-plane logic —
+    # it picks WHICH prewarmed program runs, and must never be able
+    # to build one
+    "rdma_paxos_tpu/runtime/governor.py": dict(
+        ban_imports=("jax", "jaxlib", "numpy"),
+        patterns=(r"\bjnp\b", r"shard_map", r"\bbuild_")),
     "rdma_paxos_tpu/runtime/repair.py": dict(
         ban_imports=(),
         patterns=(r"jax\.jit", r"shard_map")),

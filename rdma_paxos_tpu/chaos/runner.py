@@ -263,6 +263,7 @@ class NemesisRunner:
                  obs: Optional[Observability] = None,
                  audit: bool = True, pipeline: int = 0,
                  scan: bool = False,
+                 governor: bool = False,
                  leases: bool = True,
                  repair: bool = False,
                  corrupt_step: Optional[int] = None,
@@ -379,6 +380,20 @@ class NemesisRunner:
                     "runner scan mode and pipelined mode are "
                     "mutually exclusive (bursts are serial-path)")
             self.cluster.scan = True
+        # governor=True: the adaptive dispatch governor rides the run
+        # — observed on every finish (the engines' hook), consulted by
+        # the fused/pipelined drives, and DRAINED TO SERIAL exactly
+        # like elections and repair: any iteration with a fault event
+        # due, a timer firing, or an unknown leader runs the serial
+        # single step regardless of the governor's tier, and a serial
+        # governor decision itself forces the serial path. Decisions
+        # are pure step-domain functions of the observed backlog /
+        # arrival stream, so same-seed verdicts stay bit-reproducible
+        # (tests/test_governor.py pins determinism + zero violations).
+        self.governor = None
+        if governor:
+            from rdma_paxos_tpu.runtime.governor import attach_governor
+            self.governor = attach_governor(self.cluster, obs=self.obs)
 
     # ------------------------------------------------------------------
 
@@ -430,6 +445,12 @@ class NemesisRunner:
         the workload issues this step's entries — a pre-issue check
         would not cover them."""
         if self.pipeline < 2:
+            return False
+        # a governor that has disengaged pipelining (or shed to
+        # serial) drains the in-flight window — the same serial-path
+        # discipline elections and repair use
+        if (self.governor is not None
+                and not self.governor.decision.pipeline):
             return False
         return self._stable_window(t, leader)
 
@@ -489,6 +510,10 @@ class NemesisRunner:
             return False
         if self.link.drop or self.link.delay or self.link.dup:
             return False
+        # a serial governor decision drains the scan tier too
+        if (self.governor is not None
+                and self.governor.decision.max_k <= 1):
+            return False
         return self._stable_window(t, leader)
 
     def _one_step(self, t: int, leader: int,
@@ -499,8 +524,11 @@ class NemesisRunner:
             timeouts = self.timers.fire(self._timer_excluded())
             if (not timeouts and self._room_ok()
                     and any(len(q) for q in self.cluster.pending)):
-                # K-window scan dispatch (K sized to the backlog)
-                res = self.cluster.step_burst()
+                # K-window scan dispatch (K sized to the backlog,
+                # capped at the governor's rung when one is attached)
+                res = self.cluster.step_burst(
+                    max_k=(self.governor.decision.max_k
+                           if self.governor is not None else None))
             else:
                 res = self.cluster.step(timeouts=timeouts)
             return self._observe_res(t, res, violations)
@@ -622,6 +650,10 @@ class NemesisRunner:
                 read_counts(self.obs),
                 hub=self.cluster.reads.status(),
                 leases=self.cluster.leases.status())
+        if self.governor is not None:
+            # pure step-domain controller state: same seed -> same
+            # tier sequence -> identical summary (determinism pinned)
+            verdict["governor"] = self.governor.status()
         if not ok:
             # ok=None (state budget exceeded) is NOT a found violation —
             # label it honestly so nobody chases a bug that was never

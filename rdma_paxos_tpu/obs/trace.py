@@ -65,6 +65,10 @@ LEASE_GRANTED = "lease_granted"          # leader lease activated
 LEASE_RENEWED = "lease_renewed"          # verified-quorum renewal (sampled)
 LEASE_EXPIRED = "lease_expired"          # validity lapsed (no fresh quorum)
 LEASE_REVOKED = "lease_revoked"          # deposed / quarantined / stepped down
+GOVERNOR_TIER = "governor_tier"          # dispatch tier changed
+GOVERNOR_SHED = "governor_shed"          # SLO burn pager dropped tier to serial
+GOVERNOR_RESUME = "governor_resume"      # shed latch cleared (pager resolved)
+IDLE_QUIESCE = "idle_quiesce"            # poll loop entered idle quiescence
 
 
 class TraceEvent(NamedTuple):

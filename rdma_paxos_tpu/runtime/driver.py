@@ -38,7 +38,8 @@ from rdma_paxos_tpu.obs import Observability, trace as obs_trace
 from rdma_paxos_tpu.obs.alerts import AlertEngine, default_rules
 from rdma_paxos_tpu.obs.health import (
     HealthReporter, make_cluster_snapshot, make_snapshot)
-from rdma_paxos_tpu.obs.metrics import BATCH_BUCKETS, LATENCY_BUCKETS_S
+from rdma_paxos_tpu.obs.metrics import (
+    BATCH_BUCKETS, LATENCY_BUCKETS_S, LATENCY_BUCKETS_US)
 from rdma_paxos_tpu.obs.spans import StepPhaseProfiler
 from rdma_paxos_tpu.proxy.proxy import (
     PendingEvent, ProxyServer, ReplayEngine, spec_send_refused_dirty)
@@ -125,7 +126,11 @@ class ClusterDriver:
                  lease_opts: Optional[Dict] = None,
                  series_capacity: int = 1280,
                  metrics_port: Optional[int] = None,
-                 scan: bool = False):
+                 scan: bool = False,
+                 governor: bool = False,
+                 governor_opts: Optional[Dict] = None,
+                 idle_quiesce: bool = True,
+                 idle_backoff_max: float = 0.05):
         self.cfg = cfg
         # scan=True engages the engine's device-resident K-window scan
         # tier on the burst path: one consolidated minimal readback
@@ -257,6 +262,36 @@ class ClusterDriver:
                                            **(repair_opts or {}))
             self._wire_repair()
             self.alerts.add_hook(self.repair.on_alert)
+        # adaptive dispatch governor (runtime/governor.py): a
+        # step-domain feedback controller on the readback thread that
+        # picks the dispatch tier (serial / burst K / scan K from the
+        # prewarmed ladder), engages/disengages pipelining, and
+        # applies a bounded admission-coalescing wait — and sheds to
+        # serial the moment the commit-latency burn-rate pager fires
+        # (AlertEngine.add_hook, the RepairController.on_alert
+        # pattern), so it is a pure throughput win that can never
+        # page the latency SLO. Host bookkeeping only: zero new
+        # STEP_CACHE keys (tests/test_governor.py pins it).
+        self.governor = None
+        if governor:
+            from rdma_paxos_tpu.runtime.governor import attach_governor
+            self.governor = attach_governor(
+                self.cluster, obs=self.obs, alerts=self.alerts,
+                **(governor_opts or {}))
+            self.alerts.add_hook(self.governor.on_alert)
+        # idle quiescence: when there is no standing backlog, no
+        # blocked waiter, no election timer anywhere near due, and no
+        # admin/repair/config work, the poll loop SKIPS the device
+        # dispatch entirely and parks with an exponential backoff —
+        # instead of free-running heartbeat steps that burn the shared
+        # core the app needs (the PR 8 idle-dispatch bias, closed at
+        # the source). The alert/health cadences keep running while
+        # parked, and any intake event wakes the loop instantly.
+        self._idle_quiesce = bool(idle_quiesce)
+        self._idle_backoff_max = float(idle_backoff_max)
+        self._idle_backoff = 0.001
+        self._idle_guard = (timeout_cfg.elec_timeout_low * 0.25
+                            if timeout_cfg is not None else 0.025)
         # bounded jax.profiler captures (obs/device.py:ProfilerSession):
         # started via start_profile() (operator / bench CLI) or
         # automatically on the first page-severity alert when
@@ -629,11 +664,19 @@ class ClusterDriver:
         # are the DEFAULT e2e path — any backlog rides a fused dispatch;
         # the single-step path serves elections, deposes, and idle
         # heartbeats.
+        # governed tier: the governor's decision caps the burst at a
+        # lower ladder rung, or routes the iteration through the
+        # serial single step entirely (latency-bound regime / SLO
+        # shed). Ungoverned drivers keep the auto-sized burst.
+        dec = (self.governor.decision if self.governor is not None
+               else None)
         if (depose < 0
                 and self._leader_view >= 0 and self.cluster.last is not None
-                and self._backlog()):
+                and self._backlog()
+                and (dec is None or dec.max_k > 1)):
             self._timer_obs.start("device_step")
-            res = self.cluster.step_burst()
+            res = self.cluster.step_burst(
+                max_k=dec.max_k if dec is not None else None)
             self._timer_obs.stop("device_step")
         else:
             timeouts = []
@@ -804,6 +847,14 @@ class ClusterDriver:
                                       delta=delta)
         # cluster-level leader view (the leaderless alert's input)
         m.set("cluster_leader", self._leader_view)
+        self._cadence_observe()
+
+    def _cadence_observe(self) -> None:
+        """The wall-cadenced observability work (alert evaluation +
+        series sampling, profiler expiry, health snapshot files) —
+        shared by the per-step observe pass AND the idle-quiescence
+        branch, so a parked poll loop keeps its alerts and health
+        files fresh while skipping device dispatches."""
         now = time.monotonic()
         if now - self._alert_last >= self._alert_period:
             self._alert_last = now
@@ -978,6 +1029,8 @@ class ClusterDriver:
                     if self.cluster.leases is not None else None),
             reads=(self.cluster.reads.status()
                    if self.cluster.reads is not None else None),
+            governor=(self.governor.status()
+                      if self.governor is not None else None),
         )
 
     # ------------------------------------------------------------------
@@ -1580,6 +1633,12 @@ class ClusterDriver:
         # headroom margin covers only boundedly many in-flight bursts
         if int(c.last["end"].max()) >= self.cfg.rebase_threshold:
             return False
+        # the governor engages/disengages depth-D pipelining: until
+        # backlog has STOOD for engage_evals (or while shedding), the
+        # serial path acks a commit one dispatch sooner
+        if (self.governor is not None
+                and not self.governor.decision.pipeline):
+            return False
         # pipelining pays off only while APPEND BATCHES flow (encode
         # k+1 while k runs); with just blocked waiters and an empty
         # queue the serial loop acks a commit one dispatch sooner —
@@ -1599,6 +1658,90 @@ class ClusterDriver:
 
     def _role_is_leader(self, res, r: int) -> bool:
         return bool(res["role"][r] == int(Role.LEADER))
+
+    # ------------------------------------------------------------------
+    # idle quiescence (the PR 8 idle-dispatch bias, closed at source)
+    # ------------------------------------------------------------------
+
+    def _repair_idle(self) -> bool:
+        """True iff the repair pipeline has nothing in flight: no due
+        drain, no owned recoveries, no replica held in quarantine or
+        probation (held replicas need steps to advance their
+        hysteresis)."""
+        if self.repair is None:
+            return True
+        if self.repair.needs_drain() or self.repair.owned():
+            return False
+        return not self._repair_held_any()
+
+    def _repair_held_any(self) -> bool:
+        return bool(self.repair.blocked_replicas(0))
+
+    def _idle_margin(self) -> float:
+        """Seconds until the earliest follower election timer would
+        fire. The idle loop must dispatch a heartbeat step well before
+        that — each step carries the heartbeat, so stepping IS the
+        beat. The sharded driver overrides this: its group timers are
+        step-domain and only tick for leaderless groups, which the
+        skip gate already excludes."""
+        last = self.cluster.last
+        m = float("inf")
+        for r, rt in enumerate(self.runtimes):
+            if self._role_is_leader(last, r):
+                continue
+            m = min(m, rt.timer.remaining())
+        return m
+
+    def _can_idle_skip(self) -> bool:
+        """True iff this iteration may skip the device dispatch
+        entirely: a led, healthy, traffic-free cluster with no admin /
+        repair / config work due and every follower election timer
+        comfortably far from firing. Conservative by construction —
+        any doubt dispatches the step."""
+        if not self._idle_quiesce:
+            return False
+        c = self.cluster
+        if c.last is None or self._leader_view < 0:
+            return False
+        # chaos drills (attached link models) own their own timing —
+        # getattr both ways: SimCluster has link_model, ShardedCluster
+        # has a per-group link_models dict
+        if (getattr(c, "link_model", None) is not None
+                or getattr(c, "link_models", None)):
+            return False
+        # an active profiler capture wants the serving path visible
+        if self.profile_session is not None and self.profile_session.active:
+            return False
+        with self._lock:
+            if (self._recover_req is not None
+                    or self._reset_req is not None
+                    or self._ckpt_req is not None):
+                return False
+        if self._config_phase is not None:
+            return False
+        if c.need_recovery or self.stepped_down:
+            return False
+        if not self._repair_idle():
+            return False
+        if self._busy():
+            return False
+        return self._idle_margin() > self._idle_guard
+
+    def _idle_park(self) -> None:
+        """One idle-quiescence beat: count the avoided dispatch, keep
+        the alert/health cadences fresh, and park with exponential
+        backoff — bounded well inside the follower-timer margin, and
+        broken instantly by any intake event (``_wake``)."""
+        self.obs.metrics.inc("idle_dispatches_avoided_total")
+        if self._idle_backoff <= 0.001:
+            # once per quiescence episode, not per beat
+            self.obs.trace.record(obs_trace.IDLE_QUIESCE)
+        self._cadence_observe()
+        wait = min(self._idle_backoff, self._idle_margin() / 2)
+        self._idle_backoff = min(self._idle_backoff * 2,
+                                 self._idle_backoff_max)
+        self._wake.wait(timeout=max(wait, 0.0005))
+        self._wake.clear()
 
     def _drain_pipeline(self) -> bool:
         """Block until the readback thread retired every in-flight
@@ -1648,7 +1791,16 @@ class ClusterDriver:
                     return
                 if self._stop.is_set():
                     return
+                # the idle-skip check and the step share one crash
+                # handler: a raised skip-path bug must fail blocked
+                # waiters loudly, never park the loop dead silently
                 try:
+                    if self._can_idle_skip():
+                        # idle quiescence: nothing needs the device —
+                        # skip the dispatch, keep the cadences live
+                        self._idle_park()
+                        continue
+                    self._idle_backoff = 0.001  # re-arm the backoff
                     self.step()
                 except Exception as exc:  # noqa: BLE001
                     self._handle_loop_crash(exc)
@@ -1663,10 +1815,29 @@ class ClusterDriver:
                     self._pl_cv.wait(timeout=0.05)
                     continue
             self._pump_submitq()
+            dec = (self.governor.decision if self.governor is not None
+                   else None)
+            if (dec is not None and dec.coalesce_us > 0
+                    and self._backlog()):
+                # bounded admission-coalescing wait (governor): at a
+                # high arrival rate with a window still filling, a
+                # beat of patience ships fuller windows — strictly
+                # bounded, never applied while shedding
+                time.sleep(dec.coalesce_us / 1e6)
+                self.obs.metrics.observe(
+                    "governor_coalesce_us", dec.coalesce_us,
+                    buckets=LATENCY_BUCKETS_US)
+                self._pump_submitq()
             try:
                 self._timer_obs.start("device_step")
-                if self._backlog():
-                    ticket = self.cluster.begin_burst()
+                # dec.max_k can flip to 1 (SLO shed) between
+                # _pipeline_ready and here: honor it with a no-take
+                # heartbeat dispatch — never a burst; the next
+                # iteration sees pipeline disengaged and drains to
+                # the serial path
+                if self._backlog() and (dec is None or dec.max_k > 1):
+                    ticket = self.cluster.begin_burst(
+                        max_k=dec.max_k if dec is not None else None)
                 else:
                     # waiters with empty queues: quorum/commit trails
                     # the last append by a step — advance it (no batch

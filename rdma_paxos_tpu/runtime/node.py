@@ -192,6 +192,18 @@ class NodeDaemon:
                 registry=self.obs.metrics, health_fn=self.health,
                 alerts=self.alerts, series=self.series,
                 port=int(port)).start()
+        # RP_GOVERNOR=1: the adaptive-dispatch governor's multi-host
+        # half (runtime/governor.py:HintGovernor). Its decision —
+        # burst / serial step / bounded admission coalesce — derives
+        # ONLY from the gathered burst_hint (the PR 6 k_needed
+        # contract), so every host derives the same collective program
+        # schedule with zero extra collectives. Like RP_BURST/RP_SCAN
+        # the env must MATCH on every host. Content (what the leader
+        # actually packs) stays local and never changes program shape.
+        self.governor = None
+        if os.environ.get("RP_GOVERNOR") == "1":
+            from rdma_paxos_tpu.runtime.governor import HintGovernor
+            self.governor = HintGovernor(cfg.batch_slots)
         self.last: Optional[Dict] = None
         self._rebase_warned = False
         # consecutive post-threshold iterations with the gathered
@@ -343,6 +355,24 @@ class NodeDaemon:
         if not self.burst_enabled:
             hint = 0
         k_needed = -(-hint // B) if hint > 0 else 0
+        # RP_GOVERNOR=1: burst / step / coalesce from the gathered
+        # hint ONLY — all hosts run the same pure decision function
+        # over the same gathered sequence, so the collective program
+        # schedule stays agreed (tests pin the agreement). "coalesce"
+        # = one serial heartbeat iteration that HOLDS the local batch
+        # (admission wait, bounded by the governor), so the next
+        # burst ships a fuller window.
+        hold_batch = False
+        if self.governor is not None and self.burst_enabled:
+            tier = self.governor.decide(hint)
+            self.obs.metrics.inc("dispatch_tier", tier=(
+                "burst%d" % self.BURST_K if tier == "burst" else
+                "serial"))
+            if tier == "coalesce":
+                k_needed = 0
+                hold_batch = True
+                self.obs.metrics.inc("governor_coalesce_total",
+                                     replica=self.me)
         # fused bursts are the DEFAULT e2e path: ANY gathered backlog
         # rides the one fixed-K burst program (shallow content padded
         # with empty steps), so per-dispatch overhead is amortized the
@@ -395,8 +425,12 @@ class NodeDaemon:
             # are beaten below via hb_seen / leadership
         else:
             with self._lock:
-                take = self._submitq[:B]
-                self._submitq = self._submitq[B:]
+                # a coalescing iteration holds the batch (admission
+                # wait) — the heartbeat still ships, the entries ride
+                # the next, fuller, burst
+                take = [] if hold_batch else self._submitq[:B]
+                if take:
+                    self._submitq = self._submitq[B:]
                 qdepth = len(self._submitq)
             # (etype, conn, req_seq, payload) rows for make_input
             batch = [(t, c, s, f) for (t, c, f, s) in take]

@@ -348,11 +348,18 @@ class ShardedClusterDriver(ClusterDriver):
                     timeouts[g] = [cand]
                     self.obs.metrics.inc("election_timeouts_total",
                                          group=g)
+        # governed tier: per-GROUP rung decisions share one dispatch,
+        # so the program-level cap is the max rung (dec.max_k); a
+        # serial decision routes through the all-groups single step
+        dec = (self.governor.decision if self.governor is not None
+               else None)
         if (not timeouts and c.last is not None
                 and all(v >= 0 for v in self._group_views)
-                and self._backlog()):
+                and self._backlog()
+                and (dec is None or dec.max_k > 1)):
             self._timer_obs.start("device_step")
-            res = c.step_burst()
+            res = c.step_burst(max_k=dec.max_k if dec is not None
+                               else None)
             self._timer_obs.stop("device_step")
         else:
             self._timer_obs.start("device_step")
@@ -374,9 +381,27 @@ class ShardedClusterDriver(ClusterDriver):
             return False
         if int(c.last["end"].max()) >= self.cfg.rebase_threshold:
             return False
+        # the governor engages/disengages pipelining (see
+        # ClusterDriver._pipeline_ready)
+        if (self.governor is not None
+                and not self.governor.decision.pipeline):
+            return False
         # append batches only — see ClusterDriver._pipeline_ready
         with self._lock:
             return bool(any(self._submitq) or self._backlog())
+
+    def _idle_margin(self) -> float:
+        """The sharded election timers are STEP-DOMAIN (GroupStepTimer
+        ticks once per poll iteration, and only for leaderless
+        groups); the idle-skip gate already requires every group led
+        (``_leader_view >= 0``), so no timer can fire while parked —
+        the margin is unbounded and the backoff cap alone paces the
+        heartbeat."""
+        return float("inf")
+
+    def _repair_held_any(self) -> bool:
+        return any(self.repair.blocked_replicas(g)
+                   for g in range(self.G))
 
     def _update_leader_view(self, res) -> None:
         views = []
@@ -550,19 +575,7 @@ class ShardedClusterDriver(ClusterDriver):
                   sum(len(dq) for dq in self._inflight_g[r]),
                   replica=r)
         m.set("cluster_leader", self._leader_view)
-        now = time.monotonic()
-        if now - self._alert_last >= self._alert_period:
-            self._alert_last = now
-            self.evaluate_alerts()
-        self._poll_profile()
-        if self._health is not None and self._health.due():
-            try:
-                h = self.health()
-                self._health.write({rep["replica"]: rep
-                                    for rep in h["replicas"]})
-                self._health.write_cluster(h)
-            except OSError:
-                pass    # evidence I/O never kills the data path
+        self._cadence_observe()
 
     def _health_snapshots(self, res) -> Dict[int, Dict]:
         snaps = {}
@@ -598,7 +611,9 @@ class ShardedClusterDriver(ClusterDriver):
             repair=(self.repair.status()
                     if self.repair is not None else None),
             reads=(self.cluster.reads.status()
-                   if self.cluster.reads is not None else None))
+                   if self.cluster.reads is not None else None),
+            governor=(self.governor.status()
+                      if self.governor is not None else None))
         return make_cluster_snapshot(**h)
 
     def read(self, fn=None, *, key=None, group: Optional[int] = None,

@@ -115,6 +115,24 @@ def run_redigest(cluster, buf_row, lo: int, hi: int, *, group: int,
     return done
 
 
+def cap_tiers(k_tiers: Sequence[int],
+              max_k: Optional[int]) -> Tuple[int, ...]:
+    """The governed tier-cap rule, shared by BOTH engines: the fused
+    tiers bounded at ``max_k`` — always a non-empty subset of the
+    engine's prewarmed ladder, so a capped dispatch can never hit an
+    uncompiled program. ``max_k <= 1`` is the SERIAL step, not a
+    burst tier: refuse loudly rather than silently dispatching the
+    smallest burst (the SLO-shed contract promises serial)."""
+    if max_k is None:
+        return tuple(k_tiers)
+    if int(max_k) < 2:
+        raise ValueError(
+            "max_k <= 1 is the serial step tier — dispatch step(), "
+            "not a capped burst")
+    return tuple(k for k in k_tiers if k <= int(max_k)) \
+        or tuple(k_tiers[:1])
+
+
 def cap_scan_tiers(cluster, K: int) -> None:
     """Validate and cap an engine's fused-dispatch tier set at ``K``
     (the benches' ``--scan K`` contract, held in ONE place next to
@@ -460,6 +478,14 @@ class SimCluster:
         # STEP_CACHE keys (tests/test_reads.py pins it).
         self.leases = None
         self.reads = None
+        # adaptive dispatch governor (runtime/governor.py, attached
+        # via governor.attach_governor): observed at the tail of every
+        # finish() — the readback thread under the pipelined driver —
+        # exactly like leases/reads. Pure host bookkeeping: the tier
+        # it picks is always one of the prewarmed K_TIERS programs,
+        # so it adds no STEP_CACHE keys (tests/test_governor.py pins
+        # the ladder-only contract).
+        self.governor = None
         # replicas barred from SERVING reads by the repair pipeline
         # (digest quarantine AND the storm policy, whose holds leave
         # replay running and so never enter need_recovery) — consulted
@@ -644,12 +670,19 @@ class SimCluster:
         self._dispatch_clock += 1
         return ticket
 
-    def begin_burst(self) -> StepTicket:
+    def _tiers(self, max_k: Optional[int]) -> Tuple[int, ...]:
+        """Fused tiers bounded at ``max_k`` (the shared ``cap_tiers``
+        rule — a subset of ``K_TIERS``, never a new compile)."""
+        return cap_tiers(self.K_TIERS, max_k)
+
+    def begin_burst(self, max_k: Optional[int] = None) -> StepTicket:
         """Encode + DISPATCH up to ``max(K_TIERS)`` fused protocol
         steps; returns immediately with the in-flight ticket. Capacity
         sizing subtracts appends reserved by OTHER in-flight tickets,
         so pipelined bursts can never overrun the ring (a mid-burst
-        drop would reorder a connection's fragments)."""
+        drop would reorder a connection's fragments). ``max_k`` caps
+        the tier choice (and the take) at a lower rung of the same
+        ladder — the governor's dial."""
         cfg, R, B = self.cfg, self.R, self.cfg.batch_slots
         assert self.last is not None, "burst requires a stepped cluster"
         prof = self.profiler
@@ -660,6 +693,7 @@ class SimCluster:
             raise ValueError(
                 "psum fan-out requires full connectivity; use "
                 "fanout='gather' to model partitions")
+        tiers = self._tiers(max_k)
         with self._host_lock:
             # capacity sizing: never enqueue more than the ring can
             # take without drops, so mid-burst drops (which would
@@ -673,14 +707,14 @@ class SimCluster:
                 n = clamp_burst_take(
                     len(self.pending[r]), int(last["end"][r]),
                     int(last["head"][r]), cfg.n_slots,
-                    self.K_TIERS[-1] * B, int(reserved[r]))
+                    tiers[-1] * B, int(reserved[r]))
                 take_n.append(n)
                 taken.append(self.pending[r][:n])
                 self.pending[r] = self.pending[r][n:]
             qdepth = np.array([len(q) for q in self.pending], np.int32)
             applied = self.applied.astype(np.int32)
         k_needed = max(1, max(-(-n // B) for n in take_n))
-        K = next(k for k in self.K_TIERS if k >= k_needed)
+        K = next(k for k in tiers if k >= k_needed)
         bufs = self._burst_bufs(K)
         count = np.zeros((K, R), np.int32)
         for r in range(R):
@@ -831,6 +865,8 @@ class SimCluster:
             self.leases.observe(self, res)
         if self.reads is not None:
             self.reads.drain(self)
+        if self.governor is not None:
+            self.governor.observe(self, res)
         if burst or scan:
             B = self.cfg.batch_slots
             self._staging.release(ticket.bufs, [
@@ -902,7 +938,8 @@ class SimCluster:
             self._STEP_CACHE[key] = fn
         return fn
 
-    def step_burst(self) -> Dict[str, np.ndarray]:
+    def step_burst(self, max_k: Optional[int] = None
+                   ) -> Dict[str, np.ndarray]:
         """Drain the pending queues through up to ``max(K_TIERS)`` fused
         protocol steps in ONE device dispatch (multi-step driver mode —
         the host-side analog of the reference's busy commit loop). No
@@ -910,9 +947,10 @@ class SimCluster:
         burst while a leader is known. Returns the final step's outputs
         (``accepted`` aggregated over the burst). With ``scan=True``
         the dispatch rides the K-window scan tier (same step outputs,
-        consolidated readback + in-dispatch replay rows)."""
+        consolidated readback + in-dispatch replay rows). ``max_k``
+        caps the tier at a lower ladder rung (the governor's dial)."""
         require_drained(self._tickets, "step_burst")
-        return self.finish(self.begin_burst())
+        return self.finish(self.begin_burst(max_k=max_k))
 
     def _build_step(self, *, elections: bool):
         """Compile (or fetch cached) the protocol step for this cluster's

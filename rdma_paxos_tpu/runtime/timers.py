@@ -46,6 +46,12 @@ class ElectionTimer:
     def expired(self) -> bool:
         return self._clock() >= self._deadline
 
+    def remaining(self) -> float:
+        """Seconds until this timer would fire (0.0 when already
+        expired) — the idle-quiescence margin: a parked poll loop must
+        wake and heartbeat well before any follower timer fires."""
+        return max(0.0, self._deadline - self._clock())
+
     def false_positive(self) -> None:
         self.low = min(self.low * 1.5, self.high)
         self.beat()

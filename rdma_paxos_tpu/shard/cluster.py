@@ -70,7 +70,7 @@ from rdma_paxos_tpu.parallel.mesh import (
     stack_group_states)
 from rdma_paxos_tpu.runtime.hostpath import LazyReplayStream
 from rdma_paxos_tpu.runtime.sim import (
-    STEP_CACHE, SimCluster, StagingPool, StepTicket,
+    STEP_CACHE, SimCluster, StagingPool, StepTicket, cap_tiers,
     clamp_burst_take, decode_window, pack_rows, rebase_delta_of,
     requeue_shortfall, require_drained)
 from rdma_paxos_tpu.shard.router import KeyRouter
@@ -246,6 +246,12 @@ class ShardedCluster:
         # spreads lease-read serving across the R replicas
         self.leases = None
         self.reads = None
+        # adaptive dispatch governor (runtime/governor.py) — observed
+        # at the tail of every finish(), per-GROUP tier decisions over
+        # the shared ladder (the dispatch uses the max rung; the
+        # per-group rungs ride the trace events). Same attach pattern
+        # and zero-new-STEP_CACHE-keys contract as SimCluster.
+        self.governor = None
         # repair-held replicas barred from read serving ({(g, r)} —
         # see SimCluster.read_blocked)
         self.read_blocked: set = set()
@@ -550,11 +556,20 @@ class ShardedCluster:
         self._dispatch_clock += 1
         return ticket
 
-    def begin_burst(self) -> StepTicket:
+    def _tiers(self, max_k):
+        """Fused tiers bounded at ``max_k`` (the shared
+        ``runtime.sim.cap_tiers`` rule — one ladder, one fallback
+        semantics, both engines; never a new STEP_CACHE key)."""
+        return cap_tiers(self.K_TIERS, max_k)
+
+    def begin_burst(self, max_k: Optional[int] = None) -> StepTicket:
         """Encode + DISPATCH up to ``max(K_TIERS)`` fused protocol
         steps for every group; returns the in-flight ticket. Capacity
         sizing subtracts appends reserved by other in-flight tickets
-        (the pipelined clamp rule — see SimCluster.begin_burst)."""
+        (the pipelined clamp rule — see SimCluster.begin_burst).
+        ``max_k`` caps the tier choice at a lower ladder rung (the
+        governor's dial — ONE program still spans all groups, so the
+        cap is the max over the per-group rungs)."""
         cfg, G, R, B = self.cfg, self.G, self.R, self.cfg.batch_slots
         assert self.last is not None, "burst requires a stepped cluster"
         prof = self.profiler
@@ -565,6 +580,7 @@ class ShardedCluster:
             raise ValueError(
                 "psum fan-out requires full connectivity; use "
                 "fanout='gather' to model partitions")
+        tiers = self._tiers(max_k)
         take_n = np.zeros((G, R), np.int64)
         qdepth = np.zeros((G, R), np.int32)
         taken: List[List[list]] = [[[] for _ in range(R)]
@@ -577,7 +593,7 @@ class ShardedCluster:
                     n = clamp_burst_take(
                         len(self.pending[g][r]),
                         int(last["end"][g, r]), int(last["head"][g, r]),
-                        cfg.n_slots, self.K_TIERS[-1] * B,
+                        cfg.n_slots, tiers[-1] * B,
                         int(reserved[g, r]))
                     take_n[g, r] = n
                     taken[g][r] = self.pending[g][r][:n]
@@ -585,7 +601,7 @@ class ShardedCluster:
                     qdepth[g, r] = len(self.pending[g][r])
             applied = self.applied.astype(np.int32)
         k_needed = max(1, int(-(-take_n.max() // B)))
-        K = next(k for k in self.K_TIERS if k >= k_needed)
+        K = next(k for k in tiers if k >= k_needed)
         bufs = self._burst_bufs(K)
         count = np.zeros((K, G, R), np.int32)
         for g in range(G):
@@ -727,6 +743,8 @@ class ShardedCluster:
             self.leases.observe(self, res)
         if self.reads is not None:
             self.reads.drain(self)
+        if self.governor is not None:
+            self.governor.observe(self, res)
         if burst or scan:
             self._staging.release(ticket.bufs, [
                 ((k, g, r), min(B, len(t) - k * B))
@@ -755,14 +773,16 @@ class ShardedCluster:
         require_drained(self._tickets, "step")
         return self.finish(self.begin_step(timeouts))
 
-    def step_burst(self) -> Dict[str, np.ndarray]:
+    def step_burst(self, max_k: Optional[int] = None
+                   ) -> Dict[str, np.ndarray]:
         """Drain every group's pending queues through up to
         ``max(K_TIERS)`` fused protocol steps in ONE device dispatch.
         Same contract as ``SimCluster.step_burst`` per group: no
         elections fire inside the burst; the caller must only burst
-        while every trafficked group has a known leader."""
+        while every trafficked group has a known leader. ``max_k``
+        caps the tier (the governor's dial)."""
         require_drained(self._tickets, "step_burst")
-        return self.finish(self.begin_burst())
+        return self.finish(self.begin_burst(max_k=max_k))
 
     # ---------------- host apply / rebase ----------------
 
