@@ -641,6 +641,144 @@ def measure_read_mix(read_ratio=0.9, cfg=None, *, n_replicas=3,
     return out
 
 
+def measure_watch_mix(watch_ratio=0.5, cfg=None, *, n_replicas=3,
+                      n_ops=2000, n_keys=32, n_watchers=4,
+                      repeats=3, seed=11, payload=24,
+                      cdc_dir=None):
+    """The streams fan-out A/B (``--watch-ratio``): drive the
+    IDENTICAL seeded write workload through two same-geometry
+    clusters —
+
+    * ``plain``    — no streams hub (the bare engine);
+    * ``attached`` — the streams hub attached with ``n_watchers``
+      subscribers each watching the first ``watch_ratio`` of the
+      keyspace, plus a CDC JSONL sink, drained concurrently.
+
+    Rounds ALTERNATE and each variant scores its fastest committed
+    write throughput (the PR 5/6 best-of methodology). The row's
+    claim: the whole streams surface — tail snapshots, pump decode,
+    fan-out, CDC export — costs <3% committed-write throughput (it
+    never enters the dispatch path; the engine only kicks a condition
+    variable), while ``watch_fanout_events_per_sec`` reports the
+    delivery rate and ``cdc_lag_entries`` the sink's distance from
+    the committed frontier after the end-of-round flush (0 = the
+    exporter kept up)."""
+    import os as _os
+    import random as _random
+    import tempfile as _tempfile
+    import time as _t
+
+    from rdma_paxos_tpu.config import LogConfig
+    from rdma_paxos_tpu.models.replicated_kvs import ReplicatedKVS
+    from rdma_paxos_tpu.obs import Observability
+    from rdma_paxos_tpu.runtime.sim import SimCluster
+    from rdma_paxos_tpu import streams as streams_mod
+
+    if cfg is None:
+        cfg = LogConfig(n_slots=512, slot_bytes=128, window_slots=64,
+                        batch_slots=16)
+    keys = [b"wk%02d" % i for i in range(n_keys)]
+    cut = max(1, min(n_keys, round(watch_ratio * n_keys)))
+    blob = b"x" * payload
+    B = cfg.batch_slots
+    CID = 6
+    if cdc_dir is None:
+        cdc_dir = _tempfile.mkdtemp(prefix="watchmix")
+    setups = {}
+    for variant in ("plain", "attached"):
+        c = SimCluster(cfg, n_replicas, fanout="psum")
+        c.obs = Observability()
+        entry = dict(c=c, req=0, subs=(), hub=None)
+        if variant == "attached":
+            hub = streams_mod.attach(
+                c, cdc_path=_os.path.join(cdc_dir, "cdc.jsonl"))
+            entry["hub"] = hub
+            entry["subs"] = [
+                hub.subscribe(0, lo=keys[0],
+                              hi=None if cut >= n_keys else keys[cut])
+                for _ in range(n_watchers)]
+        c.run_until_elected(0)
+        entry["kv"] = ReplicatedKVS(c, cap=4096)
+        setups[variant] = entry
+
+    def run_round(variant, rep):
+        ent = setups[variant]
+        c, kv, subs = ent["c"], ent["kv"], ent["subs"]
+        rng = _random.Random(f"watchmix:{seed}:{rep}")
+        order = [rng.randrange(n_keys) for _ in range(n_ops)]
+        req = ent["req"]
+        pend: set = set()
+        done = steps = events = 0
+        i = 0
+        t0 = _t.perf_counter()
+        while done < n_ops:
+            budget = B
+            while i < len(order) and budget > 0:
+                req += 1
+                kv.put(0, keys[order[i]], blob, client_id=CID,
+                       req_id=req)
+                pend.add(req)
+                i += 1
+                budget -= 1
+            c.step()
+            steps += 1
+            kv._fold(0)
+            mark = kv.last_req[0].get(CID, 0)
+            done_now = [q for q in pend if q <= mark]
+            for q in done_now:
+                pend.discard(q)
+            done += len(done_now)
+            for s in subs:
+                events += len(s.poll(max_n=1024))
+        dt = _t.perf_counter() - t0
+        ent["req"] = req
+        hub = ent["hub"]
+        lag = 0
+        if hub is not None:
+            # flush: the pump drains asynchronously — wait it out so
+            # the fan-out count covers every committed write and the
+            # reported CDC lag is the exporter's true residue
+            target = hub.tails[0].length()
+            deadline = _t.monotonic() + 10
+            while (hub.watch.cursors().get(0, 0) < target
+                   and _t.monotonic() < deadline):
+                _t.sleep(0.002)
+            for s in subs:
+                events += len(s.poll(max_n=1 << 16))
+            lag = max(0, target - hub.watch.cursors().get(0, 0))
+        dt_total = _t.perf_counter() - t0
+        return dict(seconds=round(dt, 4), steps=steps, writes=done,
+                    write_ops_per_sec=round(done / dt, 1),
+                    events=events,
+                    watch_fanout_events_per_sec=round(
+                        events / dt_total, 1),
+                    cdc_lag_entries=lag)
+
+    best = {v: None for v in setups}
+    for rep in range(repeats):
+        for variant in ("plain", "attached"):
+            r = run_round(variant, rep)
+            if best[variant] is None or (r["write_ops_per_sec"]
+                                         > best[variant]
+                                         ["write_ops_per_sec"]):
+                best[variant] = r
+    hub = setups["attached"]["hub"]
+    overhead = round(
+        100.0 * (best["plain"]["write_ops_per_sec"]
+                 - best["attached"]["write_ops_per_sec"])
+        / max(best["plain"]["write_ops_per_sec"], 1e-9), 2)
+    out = dict(watch_ratio=watch_ratio, n_ops=n_ops, n_keys=n_keys,
+               n_watchers=n_watchers, watched_keys=cut,
+               repeats=repeats, plain=best["plain"],
+               attached=best["attached"],
+               watch_attach_overhead_pct=overhead,
+               cdc=dict(exported=hub.cdc.exported(0),
+                        lag=best["attached"]["cdc_lag_entries"]),
+               watch=hub.watch.status())
+    hub.fail_all("bench end")
+    return out
+
+
 def client_worker(port, n, lat, tid, pipeline=1, retries=5):
     """Pipelined client (the redis-benchmark -P analog): P commands per
     write — the app's read() picks them up as ONE buffer, so they ride a
@@ -773,6 +911,15 @@ def main():
                          "core; emits read_ops_per_sec / "
                          "write_ops_per_sec / lease_read_speedup "
                          "rows with path accounting")
+    ap.add_argument("--watch-ratio", type=float, default=0.0,
+                    help="streams fan-out workload: after the e2e "
+                         "run, A/B the identical seeded write mix "
+                         "with vs without the streams hub attached "
+                         "(watchers covering this keyspace fraction "
+                         "plus a CDC sink) — emits "
+                         "watch_fanout_events_per_sec / "
+                         "cdc_lag_entries and a "
+                         "watch_attach_overhead_pct row (target <3%%)")
     ap.add_argument("--telemetry", action="store_true",
                     help="device telemetry: compile the counter-vector "
                          "step variants (obs/device.py), export "
@@ -1342,6 +1489,29 @@ def main():
              obs=driver.obs, json_path=args.json)
         emit("lease_read_speedup", rm["lease_read_speedup"], "x",
              detail=rm, obs=driver.obs, json_path=args.json)
+
+    if args.watch_ratio > 0:
+        # on the now-quiet process (the --read-ratio reasoning): the
+        # A/B isolates the streams surface's cost on the write path —
+        # the pump and CDC exporter run concurrently with the
+        # committed workload, exactly as deployed
+        wm = measure_watch_mix(args.watch_ratio)
+        at = wm["attached"]
+        print(f"watch mix ({args.watch_ratio:.0%} keyspace watched, "
+              f"{wm['n_watchers']} watchers): "
+              f"{at['watch_fanout_events_per_sec']:.0f} events/s "
+              f"fan-out, cdc lag {wm['cdc']['lag']} "
+              f"({wm['cdc']['exported']} exported), attach overhead "
+              f"{wm['watch_attach_overhead_pct']}% (target <3%)")
+        emit("watch_fanout_events_per_sec",
+             at["watch_fanout_events_per_sec"], "events/s",
+             detail=dict(watch_ratio=args.watch_ratio, **at),
+             obs=driver.obs, json_path=args.json)
+        emit("cdc_lag_entries", wm["cdc"]["lag"], "entries",
+             detail=wm["cdc"], obs=driver.obs, json_path=args.json)
+        emit("watch_attach_overhead_pct",
+             wm["watch_attach_overhead_pct"], "%", detail=wm,
+             obs=driver.obs, json_path=args.json)
 
     if args.serve_metrics is not None:
         # ops-plane overhead on the now-quiet process (the

@@ -59,6 +59,9 @@ LOCK_MODULES = (
     "rdma_paxos_tpu/runtime/reads.py",
     "rdma_paxos_tpu/runtime/governor.py",
     "rdma_paxos_tpu/shard/cluster.py",
+    "rdma_paxos_tpu/streams/__init__.py",
+    "rdma_paxos_tpu/streams/scan.py",
+    "rdma_paxos_tpu/streams/watch.py",
 )
 
 _GUARD_RE = re.compile(

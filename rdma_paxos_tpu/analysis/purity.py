@@ -113,6 +113,24 @@ HOST_PURE_MODULES: Dict[str, dict] = {
     "rdma_paxos_tpu/obs/console.py": dict(
         ban_imports=("jax", "jaxlib"),
         patterns=(r"\bjax\b", r"\bjnp\b", r"shard_map")),
+    # log-as-product streams: scan/watch/CDC are pure host tail
+    # followers over already-decoded replay batches — pinned like
+    # reads.py so they can never grow a device dependency
+    "rdma_paxos_tpu/streams/__init__.py": dict(
+        ban_imports=("jax", "jaxlib"),
+        patterns=(r"\bjax\b", r"\bjnp\b", r"shard_map")),
+    "rdma_paxos_tpu/streams/tail.py": dict(
+        ban_imports=("jax", "jaxlib"),
+        patterns=(r"\bjax\b", r"\bjnp\b", r"shard_map")),
+    "rdma_paxos_tpu/streams/scan.py": dict(
+        ban_imports=("jax", "jaxlib"),
+        patterns=(r"\bjax\b", r"\bjnp\b", r"shard_map")),
+    "rdma_paxos_tpu/streams/watch.py": dict(
+        ban_imports=("jax", "jaxlib"),
+        patterns=(r"\bjax\b", r"\bjnp\b", r"shard_map")),
+    "rdma_paxos_tpu/streams/cdc.py": dict(
+        ban_imports=("jax", "jaxlib", "numpy"),
+        patterns=(r"\bjax\b", r"\bjnp\b", r"shard_map")),
 }
 
 

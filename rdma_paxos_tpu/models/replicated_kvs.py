@@ -103,9 +103,22 @@ class ReplicatedKVS:
     def _fold(self, r: int) -> None:
         """Fold newly committed commands into replica r's table."""
         stream = self.c.replayed[r]
-        while self._cursor[r] < len(stream):
-            etype, conn, req, payload = stream[self._cursor[r]]
-            self._cursor[r] += 1
+        n = len(stream)
+        if self._cursor[r] >= n:
+            return
+        if hasattr(stream, "segments_from"):
+            # consume ReplayBatch segments WITHOUT materializing the
+            # stream: indexing would flatten the batches to legacy
+            # tuples and destroy the log coordinates the streams/
+            # tail followers decode for resume tokens and CDC records
+            rows = []
+            for seg in stream.segments_from(self._cursor[r]):
+                rows.extend(seg.tuples() if hasattr(seg, "tuples")
+                            else seg)
+        else:
+            rows = [stream[i] for i in range(self._cursor[r], n)]
+        self._cursor[r] = n
+        for etype, conn, req, payload in rows:
             if etype != int(EntryType.SEND):
                 continue
             if len(payload) != CMD_W * 4:

@@ -86,7 +86,8 @@ def default_rules(*, commit_p99_ceiling_s: float = 0.5,
                   slo_objective: float = 0.99,
                   burn_fast_s: float = 30.0,
                   burn_slow_s: float = 300.0,
-                  burn_threshold: float = 6.0) -> List[dict]:
+                  burn_threshold: float = 6.0,
+                  cdc_lag_ceiling: int = 4096) -> List[dict]:
     """The stock SLO rule set: digest mismatch pages immediately (a
     correctness violation, not a performance blip); sustained
     leaderlessness pages; commit-latency p99 above the ceiling and a
@@ -155,6 +156,14 @@ def default_rules(*, commit_p99_ceiling_s: float = 0.5,
              bound=read_slo_bound_us, objective=slo_objective,
              fast_window_s=burn_fast_s, slow_window_s=burn_slow_s,
              burn_threshold=burn_threshold, for_evals=2),
+        # streams backpressure (PR 16): the CDC/watch pump is falling
+        # behind the committed frontier on some group — consumers are
+        # about to hit overflow-and-resume. Sustained (2 evals): a
+        # one-step burst backlog is normal. Silent without a streams
+        # hub (the gauge does not exist until one is attached).
+        dict(name="cdc_backpressure", severity=WARN, kind="gauge_cmp",
+             metric="cdc_lag_entries", op=">", value=cdc_lag_ceiling,
+             agg="max", for_evals=2),
     ]
 
 

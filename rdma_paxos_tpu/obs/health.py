@@ -44,6 +44,7 @@ CLUSTER_HEALTH_FIELDS = (
     "repair",                # RepairController.status() or None
     "leases",                # LeaseManager.status() or None
     "reads",                 # ReadHub.status() or None
+    "streams",               # StreamHub.status() or None
     "ts",
 )
 

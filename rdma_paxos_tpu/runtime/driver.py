@@ -130,7 +130,9 @@ class ClusterDriver:
                  governor: bool = False,
                  governor_opts: Optional[Dict] = None,
                  idle_quiesce: bool = True,
-                 idle_backoff_max: float = 0.05):
+                 idle_backoff_max: float = 0.05,
+                 streams: bool = False,
+                 streams_opts: Optional[Dict] = None):
         self.cfg = cfg
         # scan=True engages the engine's device-resident K-window scan
         # tier on the burst path: one consolidated minimal readback
@@ -213,6 +215,24 @@ class ClusterDriver:
         if leases:
             from rdma_paxos_tpu.runtime import reads as _reads
             _reads.attach(self.cluster, **(lease_opts or {}))
+        # log-as-product streams (streams/): ordered range scans,
+        # watch/subscribe with exactly-once resume, CDC export — one
+        # tail-follower over the committed replay streams, observed at
+        # the finish() tail. Host-side only: zero device changes, zero
+        # new STEP_CACHE keys (tests/test_streams.py pins it). A
+        # workdir defaults the CDC sink to <workdir>/cdc.jsonl when
+        # streams_opts doesn't name one.
+        self.streams = None
+        if streams:
+            from rdma_paxos_tpu import streams as _streams
+            sopts = dict(streams_opts or {})
+            if workdir and "cdc_path" not in sopts:
+                sopts["cdc_path"] = os.path.join(workdir, "cdc.jsonl")
+            if audit and "auditor" not in sopts:
+                sopts["auditor"] = getattr(self.cluster, "auditor",
+                                           None)
+            self.streams = _streams.attach(self.cluster, obs=self.obs,
+                                           **sopts)
         # time-series retention (obs/series.py): the registry sampled
         # into bounded per-series rings on the alert cadence — the
         # substrate the window-domain rules (rate_window / burn_rate)
@@ -1029,6 +1049,8 @@ class ClusterDriver:
                     if self.cluster.leases is not None else None),
             reads=(self.cluster.reads.status()
                    if self.cluster.reads is not None else None),
+            streams=(self.cluster.streams.status()
+                     if self.cluster.streams is not None else None),
             governor=(self.governor.status()
                       if self.governor is not None else None),
         )
@@ -1922,6 +1944,9 @@ class ClusterDriver:
                 if self.cluster.reads is not None:
                     self.cluster.reads.fail_all(
                         "stop (wedged poll thread)")
+                if self.cluster.streams is not None:
+                    self.cluster.streams.fail_all(
+                        "stop (wedged poll thread)")
                 with self._lock:
                     n = sum(len(rt.inflight) for rt in self.runtimes)
                     for rt in self.runtimes:
@@ -1950,6 +1975,11 @@ class ClusterDriver:
         # (queued reads the same: no step will ever confirm them)
         if self.cluster.reads is not None:
             self.cluster.reads.fail_all("stop")
+        # watchers/scans the same: the pump must quiesce and every
+        # blocked subscriber poll must fail fast (clients resume
+        # elsewhere with their tokens); flushes the CDC sink
+        if self.cluster.streams is not None:
+            self.cluster.streams.fail_all("stop")
         with self._lock:
             for rt in self.runtimes:
                 self._fail_inflight_locked(rt, "stop")

@@ -210,6 +210,17 @@ class DispatchGovernor:
         under the engine host lock (the pending queues belong to the
         dispatch/readback split)."""
         backlog = self._backlogs(cluster)
+        # a deep watch backlog is demand too: the streams hub's
+        # undispatched tail + subscriber queue depth drains through
+        # the same committed frontier the dispatch advances (consulted
+        # the way repair and elections already are; read WITHOUT the
+        # engine host lock — the hub's own lock suffices and must
+        # never nest inside it)
+        streams = getattr(cluster, "streams", None)
+        if streams is not None:
+            sb = streams.backlogs()
+            for g in range(min(len(sb), len(backlog))):
+                backlog[g] += int(sb[g])
         accepted = self._accepted(res)
         scan = bool(getattr(cluster, "scan", False))
         with self._lock:

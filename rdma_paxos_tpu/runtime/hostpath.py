@@ -41,7 +41,7 @@ from typing import List, Optional, Sequence, Tuple
 import numpy as np
 
 from rdma_paxos_tpu.consensus.log import (
-    EntryType, M_CONN, M_GEN, M_LEN, M_REQID, M_TYPE)
+    EntryType, M_CONN, M_GEN, M_GIDX, M_LEN, M_REQID, M_TERM, M_TYPE)
 
 # module-wide switch between the vectorized hot path and the scalar
 # reference loops — flipped by the host_path_speedup A/B benches
@@ -147,9 +147,10 @@ class ReplayBatch:
     tests and cold consumers."""
 
     __slots__ = ("types", "conns", "reqs", "gens", "lens", "blob",
-                 "offs")
+                 "offs", "terms", "gidx")
 
-    def __init__(self, types, conns, reqs, gens, lens, blob, offs):
+    def __init__(self, types, conns, reqs, gens, lens, blob, offs,
+                 terms=None, gidx=None):
         self.types = types        # [n] i32
         self.conns = conns        # [n] i32
         self.reqs = reqs          # [n] i32
@@ -157,6 +158,11 @@ class ReplayBatch:
         self.lens = lens          # [n] i64, clipped to the slot width
         self.blob = blob          # bytes — compacted payloads
         self.offs = offs          # [n + 1] i64 cumsum offset table
+        # log coordinates (streams/: scan cuts, watch resume tokens,
+        # CDC records) — None on plan-only batches built outside the
+        # decode path, where no wm rows exist to source them from
+        self.terms = terms        # [n] i64 M_TERM, or None
+        self.gidx = gidx          # [n] i64 absolute index, or None
 
     def __len__(self) -> int:
         return len(self.types)
@@ -179,10 +185,12 @@ class ReplayBatch:
         gathers)."""
         if start <= 0:
             return self
-        return ReplayBatch(self.types[start:], self.conns[start:],
-                           self.reqs[start:], self.gens[start:],
-                           self.lens[start:], self.blob,
-                           self.offs[start:])
+        return ReplayBatch(
+            self.types[start:], self.conns[start:],
+            self.reqs[start:], self.gens[start:],
+            self.lens[start:], self.blob, self.offs[start:],
+            None if self.terms is None else self.terms[start:],
+            None if self.gidx is None else self.gidx[start:])
 
     def frames(self) -> bytes:
         """Store-ready framed blob ``([u32 len][u8 etype][u32 conn]
@@ -219,17 +227,21 @@ def frames_from_cols(types, conns, lens, blob: bytes, offs) -> bytes:
     return out.tobytes()
 
 
-def decode_batch(wm: np.ndarray, wd: np.ndarray,
-                 n: int) -> Optional[ReplayBatch]:
+def decode_batch(wm: np.ndarray, wd: np.ndarray, n: int,
+                 rebase: int = 0) -> Optional[ReplayBatch]:
     """Decode the first ``n`` fetched entries of a window into a
     :class:`ReplayBatch` of its CLIENT entries (CONNECT/SEND/CLOSE —
     NOOP/CONFIG rows never reach the app); None when the window holds
-    no client entries."""
+    no client entries. ``rebase`` is the caller's accumulated rollover
+    total at decode time — added to the raw ``M_GIDX`` column so the
+    batch carries ABSOLUTE log indices (decode runs before the same
+    finish()'s rebase check, so the raw indices are consistent with
+    the rebase total the caller holds)."""
     if n <= 0:
         return None
     if VECTORIZED:
-        return _decode_vec(wm, wd, n)
-    return _decode_scalar(wm, wd, n)
+        return _decode_vec(wm, wd, n, rebase)
+    return _decode_scalar(wm, wd, n, rebase)
 
 
 def _client_rows(wm, n):
@@ -239,7 +251,7 @@ def _client_rows(wm, n):
     return types, np.nonzero(client)[0]
 
 
-def _decode_scalar(wm, wd, n) -> Optional[ReplayBatch]:
+def _decode_scalar(wm, wd, n, rebase=0) -> Optional[ReplayBatch]:
     """Per-entry reference decode (the pre-vectorization loop shape):
     one bytes slice per entry, joined — bit-identical columns/blob."""
     types, idxs = _client_rows(wm, n)
@@ -262,10 +274,12 @@ def _decode_scalar(wm, wd, n) -> Optional[ReplayBatch]:
         wm[idxs, M_CONN].astype(np.int32),
         wm[idxs, M_REQID].astype(np.int32),
         wm[idxs, M_GEN].astype(np.int32),
-        lens_a, b"".join(parts), offs)
+        lens_a, b"".join(parts), offs,
+        wm[idxs, M_TERM].astype(np.int64),
+        wm[idxs, M_GIDX].astype(np.int64) + int(rebase))
 
 
-def _decode_vec(wm, wd, n) -> Optional[ReplayBatch]:
+def _decode_vec(wm, wd, n, rebase=0) -> Optional[ReplayBatch]:
     types, idxs = _client_rows(wm, n)
     if not idxs.size:
         return None
@@ -286,7 +300,9 @@ def _decode_vec(wm, wd, n) -> Optional[ReplayBatch]:
         sel(M_CONN).astype(np.int32),
         sel(M_REQID).astype(np.int32),
         sel(M_GEN).astype(np.int32),
-        lens, blob, offs)
+        lens, blob, offs,
+        sel(M_TERM).astype(np.int64),
+        sel(M_GIDX).astype(np.int64) + int(rebase))
 
 
 # ---------------------------------------------------------------------------
@@ -374,7 +390,15 @@ def stream_copy(stream) -> "LazyReplayStream":
     recipient's copy diverges from the donor's from here on — and must
     stay batch-appendable for the vectorized decode path). The one
     copy rule for every recovery path (repair installs, chaos
-    restarts)."""
+    restarts). A lazy donor is copied STRUCTURALLY — batches are
+    immutable, so sharing them keeps the log coordinates (terms/gidx)
+    the streams/ subsystem reads, and a later donor ``_materialize``
+    cannot reach into the copy."""
+    if isinstance(stream, LazyReplayStream):
+        out = LazyReplayStream(stream._flat)
+        out._tail = list(stream._tail)
+        out._tail_n = stream._tail_n
+        return out
     return LazyReplayStream(list(stream))
 
 

@@ -204,6 +204,19 @@ class NodeDaemon:
         if os.environ.get("RP_GOVERNOR") == "1":
             from rdma_paxos_tpu.runtime.governor import HintGovernor
             self.governor = HintGovernor(cfg.batch_slots)
+        # RP_CDC=1: change-data-capture export — every committed
+        # client entry this daemon applies is appended to
+        # <workdir>/replica<me>.cdc.jsonl in audit-chain coordinates
+        # (term, absolute index) with the retained window digests, so
+        # `python -m rdma_paxos_tpu.streams verify` can prove the
+        # export against the replica's audit dump. Host-side only —
+        # never joins the collective schedule.
+        self.cdc = None
+        if os.environ.get("RP_CDC") == "1":
+            from rdma_paxos_tpu.streams.cdc import CDCWriter
+            self.cdc = CDCWriter(
+                os.path.join(workdir, f"replica{self.me}.cdc.jsonl"),
+                auditor=self.auditor, obs=self.obs)
         self.last: Optional[Dict] = None
         self._rebase_warned = False
         # consecutive post-threshold iterations with the gathered
@@ -523,9 +536,15 @@ class NodeDaemon:
             # vectorized window decode + batched persist/replay/ack
             # (the shared host data plane): one framed-store append,
             # one replay plan, one ack-frontier pop per window
-            batch = hostpath.decode_batch(wm, wd, n)
+            batch = hostpath.decode_batch(wm, wd, n,
+                                          self._rebased_total)
             if batch is not None:
                 self.store.append_framed(batch.frames())
+                if self.cdc is not None:
+                    # RP_CDC=1: export the committed client entries in
+                    # audit coordinates before acks release (an
+                    # exported record is always also in the store)
+                    self.cdc.write_batch(batch)
                 own = own_of(batch.conns, batch.gens)
                 own_max, ops = hostpath.replay_plan(
                     batch, own,
@@ -839,6 +858,8 @@ class NodeDaemon:
             self._health.write({self.me: self.health()})
         except OSError:
             pass
+        if self.cdc is not None:
+            self.cdc.close()
         self.series.close()
         self.proxy.close()
         if self.replay:

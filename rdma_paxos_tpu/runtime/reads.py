@@ -381,11 +381,13 @@ class ReadTicket:
 
     __slots__ = ("group", "replica", "serve_fn", "on_done", "patience",
                  "step0", "t0", "read_index", "path", "value", "status",
-                 "_ev")
+                 "pass_ticket", "_ev")
 
     def __init__(self, serve_fn, replica: int, group: int,
-                 patience: int, step0: int, on_done):
+                 patience: int, step0: int, on_done,
+                 pass_ticket: bool = False):
         self.serve_fn = serve_fn
+        self.pass_ticket = bool(pass_ticket)
         self.replica = int(replica)
         self.group = int(group)
         self.patience = int(patience)
@@ -436,15 +438,20 @@ class ReadHub:
     def submit(self, serve_fn: Optional[Callable] = None, *,
                replica: int, group: int = 0,
                patience: Optional[int] = None,
-               step0: Optional[int] = None, on_done=None) -> ReadTicket:
+               step0: Optional[int] = None, on_done=None,
+               pass_ticket: bool = False) -> ReadTicket:
         """Queue a read at ``replica`` (thread-safe). ``step0`` anchors
         the step-domain patience; without it the first drain stamps
         the current finished step (a client thread rarely knows the
-        engine clock)."""
+        engine clock). ``pass_ticket=True`` calls ``serve_fn(ticket)``
+        instead of ``serve_fn()`` — the serve callback runs AT the
+        linearization point, and a range scan needs the confirmed
+        ``read_index`` there to pin its consistent cut."""
         t = ReadTicket(serve_fn, replica, group,
                        self.patience_steps if patience is None
                        else patience,
-                       -1 if step0 is None else step0, on_done)
+                       -1 if step0 is None else step0, on_done,
+                       pass_ticket)
         with self._lock:
             self._q.append(t)
         return t
@@ -486,7 +493,12 @@ class ReadHub:
             self._commit(t, "failed", None, None)
             return
         try:
-            value = t.serve_fn() if t.serve_fn is not None else None
+            if t.serve_fn is None:
+                value = None
+            elif t.pass_ticket:
+                value = t.serve_fn(t)
+            else:
+                value = t.serve_fn()
         except Exception:  # noqa: BLE001 — a failing serve callback
             # must fail THE READ, never the finishing (readback)
             # thread the whole data path runs on

@@ -612,6 +612,8 @@ class ShardedClusterDriver(ClusterDriver):
                     if self.repair is not None else None),
             reads=(self.cluster.reads.status()
                    if self.cluster.reads is not None else None),
+            streams=(self.cluster.streams.status()
+                     if self.cluster.streams is not None else None),
             governor=(self.governor.status()
                       if self.governor is not None else None))
         return make_cluster_snapshot(**h)

@@ -192,7 +192,7 @@ def rebase_delta_of(heads: Sequence[int], n_slots: int) -> int:
 
 def decode_window(wm: np.ndarray, wd: np.ndarray, n: int,
                   replayed: List, frames: Optional[List],
-                  collect_frames: bool) -> None:
+                  collect_frames: bool, rebase: int = 0) -> None:
     """Replay frontier rule: batched decode of ``n`` fetched entries
     (``hostpath.decode_batch`` — one compacted payload blob + cumsum
     offset table per window, zero per-entry bytes objects), appended
@@ -201,7 +201,7 @@ def decode_window(wm: np.ndarray, wd: np.ndarray, n: int,
     The single decode implementation for both engines AND both fetch
     paths (the standalone replay fetch and the scan tier's in-dispatch
     replay rows)."""
-    batch = hostpath.decode_batch(wm, wd, n)
+    batch = hostpath.decode_batch(wm, wd, n, rebase)
     if batch is None:
         return
     hostpath.extend_stream(replayed, batch)
@@ -478,6 +478,14 @@ class SimCluster:
         # STEP_CACHE keys (tests/test_reads.py pins it).
         self.leases = None
         self.reads = None
+        # log-as-product streams hub (streams/__init__.py, attached
+        # via streams.attach): observed at the finish() tail AFTER the
+        # read drain (watch cursors follow the same committed frontier
+        # reads serve from) and BEFORE the governor (a deep watch
+        # backlog is demand the governor must see). Pure host-side
+        # consumer: never enters jitted code, adds no STEP_CACHE keys
+        # (tests/test_streams.py pins it).
+        self.streams = None
         # adaptive dispatch governor (runtime/governor.py, attached
         # via governor.attach_governor): observed at the tail of every
         # finish() — the readback thread under the pipelined driver —
@@ -865,6 +873,8 @@ class SimCluster:
             self.leases.observe(self, res)
         if self.reads is not None:
             self.reads.drain(self)
+        if self.streams is not None:
+            self.streams.observe(self, res)
         if self.governor is not None:
             self.governor.observe(self, res)
         if burst or scan:
@@ -1209,7 +1219,8 @@ class SimCluster:
                     self.need_recovery.add(r)       # slot recycled
                     continue
                 decode_window(wm, wd, n, self.replayed[r],
-                              self.frames[r], self.collect_frames)
+                              self.frames[r], self.collect_frames,
+                              rebase=self.rebased_total)
                 self.applied[r] += n
         # Force-pruned laggards: when the ring no longer PHYSICALLY holds
         # entry `applied` (a newer entry recycled its slot — possible
@@ -1250,7 +1261,8 @@ class SimCluster:
                     self.need_recovery.add(r)       # slot recycled
                     continue
                 decode_window(wm, wd, n, self.replayed[r],
-                              self.frames[r], self.collect_frames)
+                              self.frames[r], self.collect_frames,
+                              rebase=self.rebased_total)
                 self.applied[r] += n
 
     # ---------------- inspection ----------------

@@ -246,6 +246,10 @@ class ShardedCluster:
         # spreads lease-read serving across the R replicas
         self.leases = None
         self.reads = None
+        # log-as-product streams hub (streams/__init__.py) — same
+        # attach pattern and zero-new-STEP_CACHE-keys contract as
+        # SimCluster, widened by the group axis (per-group cursors).
+        self.streams = None
         # adaptive dispatch governor (runtime/governor.py) — observed
         # at the tail of every finish(), per-GROUP tier decisions over
         # the shared ladder (the dispatch uses the max rung; the
@@ -743,6 +747,8 @@ class ShardedCluster:
             self.leases.observe(self, res)
         if self.reads is not None:
             self.reads.drain(self)
+        if self.streams is not None:
+            self.streams.observe(self, res)
         if self.governor is not None:
             self.governor.observe(self, res)
         if burst or scan:
@@ -828,7 +834,8 @@ class ShardedCluster:
                         continue
                     decode_window(wm, wd, n, self.replayed[g][r],
                                   self.frames[g][r],
-                                  self.collect_frames)
+                                  self.collect_frames,
+                                  rebase=int(self.rebased_total[g]))
                     self.applied[g, r] += n
                     t_group[g] = (t_group.get(g, 0)
                                   + _time.perf_counter_ns() - t0)
@@ -856,7 +863,8 @@ class ShardedCluster:
                     self.need_recovery.add((g, r))
                     continue
                 decode_window(wm, wd, n, self.replayed[g][r],
-                              self.frames[g][r], self.collect_frames)
+                              self.frames[g][r], self.collect_frames,
+                              rebase=int(self.rebased_total[g]))
                 self.applied[g, r] += n
                 t_group[g] = (t_group.get(g, 0)
                               + _time.perf_counter_ns() - t0)

@@ -1,0 +1,678 @@
+"""Log-as-product streams (``streams/``): ordered range scans,
+watch/subscribe with exactly-once resume, digest-verified CDC export.
+
+Covers the PR 16 acceptance surface:
+
+* the wire-codec constants the tail follower redeclares (host-purity)
+  pinned equal to ``models/kvs.py``'s, plus a decode round-trip;
+* scan pagination with a consistent-cut token: a leader crash plus
+  overwrites/deletes MID-SCAN never tear the result — later pages
+  still serve the at-cut values; pin expiry is an explicit
+  ``TokenExpired``, never a silent tear;
+* watch exactly-once: unit-level token resume (zero dups, zero
+  gaps), retention-window ``ResumeExpired``, and the chaos verdict —
+  a NemesisRunner crash/partition schedule with two scripted
+  mid-run reconnects delivers the committed PUT/RM sequence exactly
+  once, deterministically for a seed;
+* CDC export verified against the AuditLedger (chain + digests), a
+  flipped byte detected and named by ``(term, index)``, and the
+  ``python -m rdma_paxos_tpu.streams verify`` CLI exiting 0/1;
+* sharded range fan-out with router-aware narrowing;
+* the cache-key guard: streams add ZERO STEP_CACHE keys and leave
+  step outputs bit-identical attached vs detached;
+* drain-path decoupling (S2): a WEDGED watcher (never polls, queue
+  overflowed) does not delay queued point reads;
+* the RP_SANITIZE runtime lock sanitizer armed on the streams hubs.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from rdma_paxos_tpu.config import LogConfig
+from rdma_paxos_tpu.models.replicated_kvs import ReplicatedKVS
+from rdma_paxos_tpu.obs import Observability
+from rdma_paxos_tpu.runtime import reads as reads_mod
+from rdma_paxos_tpu.runtime.sim import STEP_CACHE, SimCluster
+from rdma_paxos_tpu import streams as streams_mod
+from rdma_paxos_tpu.streams import tail as tail_mod
+from rdma_paxos_tpu.streams.cdc import chain_link, verify_export
+from rdma_paxos_tpu.streams.scan import (
+    TokenExpired, groups_for_range, key_range)
+from rdma_paxos_tpu.streams.watch import ResumeExpired
+
+# same geometry as tests/test_reads.py so compiled steps are shared
+CFG = LogConfig(n_slots=128, slot_bytes=128, window_slots=32,
+                batch_slots=16)
+
+
+def _cluster(audit=False, **stream_kw):
+    c = SimCluster(CFG, 3, audit=audit)
+    c.obs = Observability()
+    reads_mod.attach(c)
+    hub = streams_mod.attach(c, **stream_kw)
+    return c, hub
+
+
+def _put_committed(c, kv, leader, key, val, req, client=9):
+    kv.put(leader, key, val, client_id=client, req_id=req)
+    for _ in range(8):
+        c.step()
+        kv._fold(leader)
+        if kv.last_req[leader].get(client, 0) >= req:
+            return
+    raise AssertionError("put did not commit")
+
+
+def _rm_committed(c, kv, leader, key, req, client=9):
+    kv.remove(leader, key, client_id=client, req_id=req)
+    for _ in range(8):
+        c.step()
+        kv._fold(leader)
+        if kv.last_req[leader].get(client, 0) >= req:
+            return
+    raise AssertionError("rm did not commit")
+
+
+def _serve_blocking(c, fn, max_steps=600):
+    """Run a blocking client call (scan) in a thread while stepping
+    the cluster so the ReadHub can confirm and serve its pages."""
+    box = {}
+
+    def work():
+        try:
+            box["out"] = fn()
+        except BaseException as exc:  # noqa: BLE001 — reraised below
+            box["err"] = exc
+
+    th = threading.Thread(target=work)
+    th.start()
+    for _ in range(max_steps):
+        c.step()
+        if not th.is_alive():
+            break
+    th.join(10)
+    if "err" in box:
+        raise box["err"]
+    assert "out" in box, "client call did not complete"
+    return box["out"]
+
+
+def _drain(sub, n, timeout=8.0):
+    evs = []
+    deadline = time.time() + timeout
+    while len(evs) < n and time.time() < deadline:
+        evs.extend(sub.poll())
+        time.sleep(0.005)
+    return evs
+
+
+# ---------------------------------------------------------------------------
+# codec constants (host-pure redeclaration pinned to models/kvs.py)
+# ---------------------------------------------------------------------------
+
+def test_tail_codec_constants_pinned_to_models_kvs():
+    from rdma_paxos_tpu.models import kvs as mkvs
+    assert tail_mod.CMD_BYTES == mkvs.CMD_W * 4
+    assert tail_mod.KEY_BYTES == mkvs.KEY_W * 4
+    assert tail_mod.VAL_BYTES == mkvs.VAL_W * 4
+    assert (tail_mod.OP_PUT, tail_mod.OP_RM) == (mkvs.OP_PUT,
+                                                 mkvs.OP_RM)
+    # decode round-trip over the real encoder
+    payload = mkvs.encode_cmd(mkvs.OP_PUT, b"key", b"val").tobytes()
+    assert tail_mod.decode_kvs(payload) == (mkvs.OP_PUT, b"key",
+                                            b"val")
+    assert tail_mod.decode_kvs(b"short") is None
+
+
+def test_key_range_prefix_math():
+    assert key_range(prefix=b"user/") == (b"user/", b"user0")
+    assert key_range(lo=b"a", hi=b"b") == (b"a", b"b")
+    assert key_range() == (b"", None)
+    assert key_range(prefix=b"\xff\xff") == (b"\xff\xff", None)
+    with pytest.raises(ValueError):
+        key_range(prefix=b"p", lo=b"a")
+
+
+# ---------------------------------------------------------------------------
+# ordered range scans: pagination, consistent cut, expiry
+# ---------------------------------------------------------------------------
+
+def test_scan_pagination_ordered():
+    c, hub = _cluster()
+    c.run_until_elected(0)
+    kv = ReplicatedKVS(c, cap=256)
+    hub.kvs = kv
+    for i in range(10):
+        _put_committed(c, kv, 0, b"k%02d" % i, b"v%d" % i, i + 1)
+    _put_committed(c, kv, 0, b"zz", b"out-of-range", 11)
+    page = _serve_blocking(c, lambda: hub.scan(prefix=b"k", limit=4))
+    assert [k for k, _ in page["items"]] == [b"k00", b"k01", b"k02",
+                                             b"k03"]
+    assert page["token"] is not None and not page["done"]
+    rows = _serve_blocking(
+        c, lambda: hub.scan_all(prefix=b"k", limit=4))
+    assert [k for k, _ in rows] == [b"k%02d" % i for i in range(10)]
+    assert all(v == b"v%d" % i for i, (_, v) in enumerate(rows))
+    assert hub.scans.pin_count() == 0     # whole-scan end released it
+
+
+def test_scan_consistent_cut_survives_leader_crash_and_writes():
+    """The pinned acceptance scenario: pagination that STARTED under
+    leader 0 keeps serving the at-cut values after 0 crashes, a new
+    leader commits overwrites and a delete, and the remaining pages
+    are fetched under the new regime — no torn read, no duplicate,
+    no skip. A FRESH scan afterwards sees the new world."""
+    c, hub = _cluster()
+    c.run_until_elected(0)
+    kv = ReplicatedKVS(c, cap=256)
+    hub.kvs = kv
+    for i in range(8):
+        _put_committed(c, kv, 0, b"k%02d" % i, b"A%d" % i, i + 1)
+    page1 = _serve_blocking(c, lambda: hub.scan(prefix=b"k", limit=3))
+    assert [k for k, _ in page1["items"]] == [b"k00", b"k01", b"k02"]
+    tok = page1["token"]
+    assert tok is not None
+    # leader 0 crashes (isolated); 1 takes over and mutates mid-scan
+    c.partition([[0], [1, 2]])
+    c.run_until_elected(1)
+    _put_committed(c, kv, 1, b"k04", b"B4", 1, client=7)
+    _rm_committed(c, kv, 1, b"k06", 2, client=7)
+    _put_committed(c, kv, 1, b"k08", b"B8", 3, client=7)  # new key
+    # continue the SAME scan: at-cut values, k06 still present, no k08
+    rest = []
+    while tok is not None:
+        page = _serve_blocking(c, lambda t=tok: hub.scan(token=t))
+        rest.extend(page["items"])
+        tok = page["token"]
+    got = dict(page1["items"]) | dict(rest)
+    assert sorted(got) == [b"k%02d" % i for i in range(8)]
+    assert got[b"k04"] == b"A4"          # overwrite invisible at cut
+    assert got[b"k06"] == b"A6"          # delete invisible at cut
+    # a fresh scan sees the post-crash world
+    rows = dict(_serve_blocking(
+        c, lambda: hub.scan_all(prefix=b"k", limit=16)))
+    assert rows[b"k04"] == b"B4" and b"k06" not in rows
+    assert rows[b"k08"] == b"B8"
+    assert hub.scans.pin_count() == 0
+
+
+def test_scan_pin_expiry_is_explicit_token_expired():
+    c, hub = _cluster(pin_steps=4)
+    c.run_until_elected(0)
+    kv = ReplicatedKVS(c, cap=256)
+    hub.kvs = kv
+    for i in range(6):
+        _put_committed(c, kv, 0, b"k%d" % i, b"v", i + 1)
+    page = _serve_blocking(c, lambda: hub.scan(prefix=b"k", limit=2))
+    tok = page["token"]
+    for _ in range(8):                  # pin_steps elapse
+        c.step()
+    with pytest.raises(TokenExpired):
+        _serve_blocking(c, lambda: hub.scan(token=tok))
+    assert hub.scans.status()["pins_expired"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# watch/subscribe: exactly-once resume
+# ---------------------------------------------------------------------------
+
+def test_watch_token_resume_no_dups_no_gaps():
+    c, hub = _cluster()
+    c.run_until_elected(0)
+    kv = ReplicatedKVS(c, cap=256)
+    sub = hub.subscribe(0, prefix=b"u/")
+    for i in range(6):
+        _put_committed(c, kv, 0, b"u/%d" % i, b"v%d" % i, i + 1)
+    first = _drain(sub, 6)
+    assert [e.key for e in first] == [b"u/%d" % i for i in range(6)]
+    tok = sub.token()
+    assert tok["group"] == 0 and tok["index"] >= 0
+    sub.close()
+    # deltas committed while disconnected
+    for i in range(6, 10):
+        _put_committed(c, kv, 0, b"u/%d" % i, b"v%d" % i, i + 1)
+    sub2 = hub.subscribe(0, prefix=b"u/", token=tok)
+    rest = _drain(sub2, 4)
+    assert [e.key for e in rest] == [b"u/%d" % i for i in range(6, 10)]
+    # exactly-once across the reconnect: zero dups, zero gaps
+    idents = [(e.conn, e.req) for e in first + rest]
+    assert len(idents) == len(set(idents)) == 10
+    # the live fan-out delivered 6 (the replayed 4 ride the resume)
+    assert hub.status()["watch"]["events_total"] >= 6
+    assert c.obs.metrics.get("watch_events_delivered_total",
+                             group=0) >= 6
+
+
+def test_watch_resume_past_retention_raises():
+    c, hub = _cluster(retain=3)
+    c.run_until_elected(0)
+    kv = ReplicatedKVS(c, cap=256)
+    sub = hub.subscribe(0)
+    _put_committed(c, kv, 0, b"k0", b"v", 1)
+    got = _drain(sub, 1)
+    tok = got[0].token()
+    sub.close()
+    for i in range(1, 8):               # push the window past tok
+        _put_committed(c, kv, 0, b"k%d" % i, b"v", i + 1)
+    deadline = time.time() + 5
+    while time.time() < deadline:       # pump is async: await trim
+        try:
+            hub.subscribe(0, token=tok).close()
+        except ResumeExpired:
+            break
+        time.sleep(0.01)
+    else:
+        pytest.fail("resume past the retained window never expired")
+
+
+def test_watch_chaos_leader_crash_exactly_once_deterministic():
+    """The chaos acceptance: an all-keys watch with two scripted
+    token reconnects under a crash/partition schedule delivers the
+    committed PUT/RM sequence exactly once and in order — and the
+    same seed reproduces the identical streams verdict."""
+    from rdma_paxos_tpu.chaos.runner import NemesisRunner
+    verdicts = []
+    for _ in range(2):
+        r = NemesisRunner(seed=11, steps=100,
+                          fault_kinds=("crash", "partition"),
+                          streams=True)
+        v = r.run()
+        assert v["ok"], v
+        s = v["streams"]
+        assert s["dups"] == 0 and s["gaps"] == 0 and s["ordered"]
+        assert s["events"] == s["expected"] > 0
+        assert s["resumes"] == 2
+        verdicts.append({k: s[k] for k in ("events", "expected",
+                                           "dups", "gaps", "ordered",
+                                           "resumes")})
+    assert verdicts[0] == verdicts[1]
+
+
+# ---------------------------------------------------------------------------
+# CDC export: digest verification, tamper detection, CLI
+# ---------------------------------------------------------------------------
+
+def test_chain_link_is_order_sensitive():
+    a = chain_link(0, 0, 1, 5, 3, 9, 1, b"payload")
+    assert a == chain_link(0, 0, 1, 5, 3, 9, 1, b"payload")
+    assert a != chain_link(0, 0, 1, 6, 3, 9, 1, b"payload")
+    assert a != chain_link(1, 0, 1, 5, 3, 9, 1, b"payload")
+    assert a != chain_link(0, 0, 1, 5, 3, 9, 1, b"payloae")
+
+
+def test_cdc_export_verifies_and_flipped_byte_is_named(tmp_path):
+    cdc_path = str(tmp_path / "cdc.jsonl")
+    c = SimCluster(CFG, 3, audit=True)
+    c.obs = Observability()
+    reads_mod.attach(c)
+    hub = streams_mod.attach(c, cdc_path=cdc_path, auditor=c.auditor)
+    c.run_until_elected(0)
+    kv = ReplicatedKVS(c, cap=256)
+    for i in range(8):
+        _put_committed(c, kv, 0, b"k%d" % i, b"v%d" % i, i + 1)
+    # flush: wait for the async pump, then close the sink
+    target = hub.tails[0].length()
+    deadline = time.time() + 5
+    while (hub.watch.cursors().get(0, 0) < target
+           and time.time() < deadline):
+        time.sleep(0.01)
+    hub.fail_all("test flush")
+    dump = c.auditor.dump()
+    v = verify_export(cdc_path, [dump])
+    assert v["ok"] and v["records"] > 0 and v["checked_digests"] > 0
+    # tamper: flip one payload byte -> FAIL naming the first bad entry
+    data = open(cdc_path, "r").read().splitlines()
+    rec0 = json.loads(data[0])
+    p = rec0["payload"]
+    rec0["payload"] = ("0" if p[0] != "0" else "1") + p[1:]
+    bad_path = str(tmp_path / "cdc_bad.jsonl")
+    with open(bad_path, "w") as f:
+        f.write("\n".join([json.dumps(rec0)] + data[1:]) + "\n")
+    v2 = verify_export(bad_path, [dump])
+    assert not v2["ok"]
+    assert v2["bad"] == (rec0["term"], rec0["index"])
+    # the CLI is the operator surface: 0 on clean, 1 naming the entry
+    audit_path = str(tmp_path / "audit.json")
+    with open(audit_path, "w") as f:
+        json.dump(dump, f)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    ok = subprocess.run(
+        [sys.executable, "-m", "rdma_paxos_tpu.streams", "verify",
+         cdc_path, audit_path], capture_output=True, text=True,
+        env=env)
+    assert ok.returncode == 0, ok.stderr
+    bad = subprocess.run(
+        [sys.executable, "-m", "rdma_paxos_tpu.streams", "verify",
+         bad_path, audit_path], capture_output=True, text=True,
+        env=env)
+    assert bad.returncode == 1
+    assert "term=%d" % rec0["term"] in bad.stderr
+    assert "index=%d" % rec0["index"] in bad.stderr
+
+
+# ---------------------------------------------------------------------------
+# sharded engines: range fan-out, router narrowing
+# ---------------------------------------------------------------------------
+
+def test_sharded_scan_fans_out_and_router_narrows():
+    from rdma_paxos_tpu.shard.cluster import ShardedCluster
+    from rdma_paxos_tpu.shard.kvs import ShardedKVS
+    from rdma_paxos_tpu.shard.router import KeyRouter, RangeRule
+
+    router = KeyRouter(4, overrides=[RangeRule(b"pin/", b"pin0", 2)])
+    sc = ShardedCluster(CFG, 3, 4, router=router)
+    sc.obs = Observability()
+    reads_mod.attach(sc)
+    hub = streams_mod.attach(sc)
+    sc.place_leaders()
+    for _ in range(4):
+        sc.step()
+    holders = sc.leases.holders()
+    kvs = ShardedKVS(sc, cap=256)
+    hub.kvs = kvs
+    keys = ([b"user/%02d" % i for i in range(12)]
+            + [b"pin/%02d" % i for i in range(4)])
+    req = {}
+    for k in keys:
+        g = kvs.group_of(k)
+        r = req[g] = req.get(g, 0) + 1
+        kvs.groups[g].put(holders[g], k, b"V" + k, client_id=5,
+                          req_id=r)
+        for _ in range(5):
+            sc.step()
+    assert len({kvs.group_of(k) for k in keys}) > 1   # really scatters
+    # router narrowing: the pinned range maps to exactly one group
+    lo, hi = key_range(prefix=b"pin/")
+    assert groups_for_range(router, lo, hi) == [2]
+    assert groups_for_range(router, *key_range(prefix=b"user/")) \
+        == [0, 1, 2, 3]
+    rows = _serve_blocking(
+        sc, lambda: hub.scan_all(prefix=b"user/", limit=5), 2000)
+    assert [k for k, _ in rows] == sorted(
+        b"user/%02d" % i for i in range(12))      # merge-sorted
+    assert all(v == b"V" + k for k, v in rows)
+    pins = _serve_blocking(
+        sc, lambda: hub.scan_all(prefix=b"pin/", limit=8), 2000)
+    assert [k for k, _ in pins] == sorted(
+        b"pin/%02d" % i for i in range(4))
+    # the narrowed scan only ever touched group 2's index
+    assert sc.obs.metrics.get("scan_pages_total", group=2) >= 1
+    folded = hub.scans.status()["folded"]
+    touched = {g for g, pos in folded.items() if pos > 0}
+    assert 2 in touched
+    hub.fail_all("test done")
+
+
+def test_sharded_watch_isolates_groups():
+    # regression: the pump fans each group's decoded batch over ALL
+    # subscriptions, so Subscription._matches must check the group —
+    # before it did, a G>1 subscriber received sibling groups' events
+    # too (every single-group watch test passes that vacuously)
+    from rdma_paxos_tpu.shard.cluster import ShardedCluster
+    from rdma_paxos_tpu.shard.kvs import ShardedKVS
+
+    sc = ShardedCluster(CFG, 3, 2)
+    sc.obs = Observability()
+    reads_mod.attach(sc)
+    hub = streams_mod.attach(sc)
+    sc.place_leaders()
+    for _ in range(4):
+        sc.step()
+    holders = sc.leases.holders()
+    kvs = ShardedKVS(sc, cap=256)
+    subs = [hub.subscribe(g) for g in range(2)]
+    keys = [b"iso%02d" % i for i in range(12)]
+    owner = {k: kvs.group_of(k) for k in keys}
+    assert len(set(owner.values())) == 2          # both groups written
+    req = {}
+    for k in keys:
+        g = owner[k]
+        r = req[g] = req.get(g, 0) + 1
+        kvs.groups[g].put(holders[g], k, b"V" + k, client_id=6,
+                          req_id=r)
+        for _ in range(5):
+            sc.step()
+    assert hub.watch.wait_caught_up(
+        {g: hub.tails[g].length() for g in range(2)})
+    for g, sub in enumerate(subs):
+        evs = sub.poll(max_n=256)
+        assert evs and all(e.group == g for e in evs)
+        assert sorted(e.key for e in evs) == sorted(
+            k for k in keys if owner[k] == g)
+    assert hub.watch.events_total == len(keys)    # each delivered once
+    hub.fail_all("test done")
+
+
+# ---------------------------------------------------------------------------
+# cache-key guard + bit-identity (attached vs detached)
+# ---------------------------------------------------------------------------
+
+def test_streams_add_zero_step_cache_keys():
+    # a geometry no other test uses: this guard reasons about which
+    # keys THIS test's clusters add to the shared cache
+    cfg = LogConfig(n_slots=64, slot_bytes=256, window_slots=8,
+                    batch_slots=4)
+    plain = SimCluster(cfg, 3)
+    plain.run_until_elected(0)
+    plain.submit(0, b"x")
+    plain.step()
+    keys_before = set(STEP_CACHE)
+
+    attached = SimCluster(cfg, 3)
+    attached.obs = Observability()
+    reads_mod.attach(attached)
+    hub = streams_mod.attach(attached)
+    attached.run_until_elected(0)
+    kv = ReplicatedKVS(attached, cap=256)
+    hub.kvs = kv
+    sub = hub.subscribe(0)
+    for i in range(4):
+        _put_committed(attached, kv, 0, b"k%d" % i, b"v", i + 1)
+    rows = _serve_blocking(
+        attached, lambda: hub.scan_all(prefix=b"k", limit=2))
+    assert len(rows) == 4 and len(_drain(sub, 4)) == 4
+    # the WHOLE streams surface (tails + scans + watch + pump) added
+    # ZERO compiled-step cache keys: pure host bookkeeping
+    assert set(STEP_CACHE) == keys_before
+    hub.fail_all("test done")
+
+
+def test_streams_outputs_bit_identical_attached_vs_detached():
+    a = SimCluster(CFG, 3)
+    b = SimCluster(CFG, 3)
+    b.obs = Observability()
+    reads_mod.attach(b)
+    hub = streams_mod.attach(b)
+    for c in (a, b):
+        c.run_until_elected(0)
+    kva = ReplicatedKVS(a, cap=256)
+    kvb = ReplicatedKVS(b, cap=256)
+    sub = hub.subscribe(0)
+    for i in range(5):
+        kva.put(0, b"k%d" % i, b"v%d" % i, client_id=3, req_id=i + 1)
+        kvb.put(0, b"k%d" % i, b"v%d" % i, client_id=3, req_id=i + 1)
+        a.step()
+        b.step()
+    # a scan serving on b while BOTH step in lockstep
+    box = {}
+
+    def work():
+        box["rows"] = hub.scan_all(prefix=b"k", limit=2)
+
+    th = threading.Thread(target=work)
+    th.start()
+    for _ in range(100):
+        a.step()
+        b.step()
+        if not th.is_alive():
+            break
+    th.join(10)
+    assert len(box["rows"]) == 5 and len(_drain(sub, 5)) == 5
+    for k in ("term", "commit", "end", "apply", "head", "role"):
+        assert np.array_equal(a.last[k], b.last[k]), k
+    hub.fail_all("test done")
+
+
+# ---------------------------------------------------------------------------
+# S2: drain-path decoupling — a wedged watcher never delays reads
+# ---------------------------------------------------------------------------
+
+def test_wedged_watcher_does_not_delay_point_reads():
+    """The decoupling pin: a subscriber that NEVER polls (tiny queue,
+    overflowed) wedges only ITSELF — the pump thread keeps the
+    ReadHub drain path untouched, so a queued read-index point read
+    still completes in the same couple of steps it needs with no
+    watcher at all."""
+    c, hub = _cluster()
+    c.run_until_elected(0)
+    kv = ReplicatedKVS(c, cap=256)
+    hub.kvs = kv
+    wedged = hub.subscribe(0, cap=2)          # never polled
+    for i in range(12):
+        _put_committed(c, kv, 0, b"k%02d" % i, b"v", i + 1)
+    deadline = time.time() + 5
+    while not wedged.overflowed and time.time() < deadline:
+        time.sleep(0.005)
+    assert wedged.overflowed          # backpressure is EXPLICIT
+    # point read through the hub with the watcher still wedged
+    t = c.reads.submit(lambda: kv.serve_local(1, b"k00"), replica=1)
+    steps = 0
+    for _ in range(4):
+        if t.done:
+            break
+        c.step()
+        steps += 1
+    assert t.done and t.status == "ok" and t.value == b"v"
+    assert steps <= 3                 # unchanged point-read latency
+    # backlog is visible as governor demand + gauge
+    assert hub.backlogs()[0] >= 0
+    assert c.obs.metrics.get("watch_backlog_entries", group=0) >= 0
+    hub.fail_all("test done")
+    assert wedged.closed and wedged.fail_reason == "test done"
+    assert len(wedged.poll(max_n=16)) <= 2    # the bounded remnant
+    assert wedged.next(timeout=0.1) is None   # wakes, never hangs
+
+
+# ---------------------------------------------------------------------------
+# S1: runtime lock sanitizer armed on the streams hubs
+# ---------------------------------------------------------------------------
+
+def test_rp_sanitize_arms_streams_hubs(monkeypatch):
+    monkeypatch.setenv("RP_SANITIZE", "1")
+    from rdma_paxos_tpu.analysis.runtime_guard import (
+        LockDisciplineError)
+    c = SimCluster(CFG, 3)
+    c.obs = Observability()
+    reads_mod.attach(c)
+    hub = streams_mod.attach(c)
+    assert type(hub).__name__.endswith("+sanitized")
+    assert type(hub.watch).__name__.endswith("+sanitized")
+    assert type(hub.scans).__name__.endswith("+sanitized")
+    # the guarded surface still works end to end under the sanitizer
+    c.run_until_elected(0)
+    kv = ReplicatedKVS(c, cap=256)
+    sub = hub.subscribe(0)
+    _put_committed(c, kv, 0, b"k", b"v", 1)
+    assert len(_drain(sub, 1)) == 1
+    # ...and an unlocked write of a guarded field is CAUGHT
+    with pytest.raises(LockDisciplineError):
+        hub.watch.events_total = 99
+    hub.fail_all("test done")
+
+
+# ---------------------------------------------------------------------------
+# wiring: driver lifecycle, alert rule, governor demand
+# ---------------------------------------------------------------------------
+
+def test_driver_streams_wiring_health_and_stop():
+    from rdma_paxos_tpu.config import TimeoutConfig
+    from rdma_paxos_tpu.obs.health import validate_cluster
+    from rdma_paxos_tpu.runtime.driver import ClusterDriver
+    tcfg = TimeoutConfig(elec_timeout_low=1e9, elec_timeout_high=2e9)
+    d = ClusterDriver(CFG, 3, timeout_cfg=tcfg, streams=True)
+    try:
+        hub = d.cluster.streams
+        assert hub is not None and hub.cdc is None   # no workdir
+        h = d.health()
+        assert validate_cluster(h) == []
+        assert h["streams"]["stopped"] is False
+        sub = hub.subscribe(0)
+        waiter = {}
+
+        def blocked():
+            waiter["got"] = sub.next(timeout=30)
+
+        th = threading.Thread(target=blocked)
+        th.start()
+        time.sleep(0.1)
+    finally:
+        d.stop()
+    th.join(5)
+    assert not th.is_alive()          # stop released the watcher
+    assert waiter["got"] is None and sub.closed
+    assert sub.fail_reason == "stop"
+    assert d.cluster.streams.status()["stopped"] is True
+
+
+def test_driver_streams_off_by_default():
+    from rdma_paxos_tpu.config import TimeoutConfig
+    from rdma_paxos_tpu.runtime.driver import ClusterDriver
+    tcfg = TimeoutConfig(elec_timeout_low=1e9, elec_timeout_high=2e9)
+    d = ClusterDriver(CFG, 3, timeout_cfg=tcfg)
+    assert d.cluster.streams is None
+    assert d.health()["streams"] is None
+    d.stop()
+
+
+def test_cdc_backpressure_alert_rule_in_defaults():
+    from rdma_paxos_tpu.obs.alerts import default_rules
+    rules = {r["name"]: r for r in default_rules()}
+    r = rules["cdc_backpressure"]
+    assert r["metric"] == "cdc_lag_entries" and r["op"] == ">"
+    assert default_rules(cdc_lag_ceiling=7)[
+        [x["name"] for x in default_rules()].index("cdc_backpressure")
+    ]["value"] == 7
+
+
+def test_watch_mix_bench_smoke(tmp_path):
+    """S5: the ``run_bench --watch-ratio`` A/B at smoke scale — both
+    variants complete the identical committed write mix, the fan-out
+    and CDC rows account for every watched write, and the exporter
+    finishes the round caught up (lag 0)."""
+    from benchmarks.run_bench import measure_watch_mix
+    out = measure_watch_mix(0.5, cfg=CFG, n_ops=240, n_keys=8,
+                            repeats=1, seed=4,
+                            cdc_dir=str(tmp_path))
+    assert out["plain"]["writes"] == out["attached"]["writes"] == 240
+    # 4 watchers x the watched half of the keyspace
+    assert out["attached"]["events"] > 0
+    assert out["attached"]["watch_fanout_events_per_sec"] > 0
+    assert out["cdc"]["exported"] == 240 and out["cdc"]["lag"] == 0
+    assert out["watch"]["overflowed"] == 0
+
+
+def test_governor_counts_watch_backlog_as_demand():
+    from rdma_paxos_tpu.runtime.governor import attach_governor
+    c = SimCluster(CFG, 3)
+    c.obs = Observability()
+    reads_mod.attach(c)
+    hub = streams_mod.attach(c)
+    gov = attach_governor(c, obs=c.obs)
+    c.run_until_elected(0)
+    kv = ReplicatedKVS(c, cap=256)
+    hub.subscribe(0, cap=1 << 16)     # deep, never-drained queue
+    for i in range(6):
+        _put_committed(c, kv, 0, b"k%d" % i, b"v", i + 1)
+    # streams backlog reaches the governor's observe without deadlock
+    for _ in range(4):
+        c.step()
+    assert gov.status() is not None
+    assert hub.backlogs()[0] >= 1     # the wedged queue is demand
+    hub.fail_all("test done")
