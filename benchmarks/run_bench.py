@@ -779,6 +779,186 @@ def measure_watch_mix(watch_ratio=0.5, cfg=None, *, n_replicas=3,
     return out
 
 
+def measure_txn(cfg=None, *, n_replicas=3, n_groups=3, n_probe=12,
+                n_ops=400, n_keys=48, repeats=3, seed=17):
+    """The transaction bench (``--txn``), three claims on one
+    ``txn=True`` sharded geometry:
+
+    * **dispatch-count proof** — each cross-group 2PC commit (a
+      put-pair spanning two groups) is driven serially to completion
+      while counting ``ShardedCluster.dispatches``: the in-dispatch
+      commit lane resolves prepare votes + the commit decision in ~2
+      protocol dispatches (the classic coordinator pays 2 network
+      round trips PER PHASE);
+    * **commit latency vs single-key** — the same probe for a plain
+      stamped single-key put (1 dispatch), reported as a ratio;
+    * **mergeable throughput** — seeded A/B, rounds ALTERNATING and
+      each variant keeping its fastest round (the PR 5/6 best-of
+      methodology): ``merge`` drives INCR transactions through the
+      coordinator's fast path, ``plain`` drives the identical count
+      of stamped single-key puts over the same keys; the fast path
+      skips prepare entirely (one plain command per write), so its
+      committed throughput must hold ~1x plain (target >=0.9x).
+    """
+    import random as _random
+    import time as _t
+
+    from rdma_paxos_tpu.config import LogConfig
+    from rdma_paxos_tpu.obs import Observability
+    from rdma_paxos_tpu.shard.cluster import ShardedCluster
+    from rdma_paxos_tpu.shard.kvs import ShardedKVS
+    from rdma_paxos_tpu.txn import attach_coordinator
+    from rdma_paxos_tpu.txn.chaos import keys_for_groups
+
+    if cfg is None:
+        cfg = LogConfig(n_slots=512, slot_bytes=128, window_slots=64,
+                        batch_slots=16)
+    shard = ShardedCluster(cfg, n_replicas, n_groups, txn=True)
+    shard.obs = Observability()
+    kv = ShardedKVS(shard, cap=4096)
+    attach_coordinator(kv, timeout_steps=256)
+    shard.place_leaders()
+    G = shard.G
+    B = cfg.batch_slots
+    CID = 9
+
+    pools = keys_for_groups(kv.router, n_probe + n_keys // G + 2,
+                            prefix=b"txb")
+
+    # ---- serial probes: dispatches + wall latency per commit ----
+    def probe_2pc(i):
+        ga, gb = i % G, (i + 1) % G
+        ka = pools[ga][i]
+        kb = pools[gb][i]
+        d0, t0 = shard.dispatches, _t.perf_counter()
+        h = kv.transact([("put", ka, b"a%d" % i),
+                         ("put", kb, b"b%d" % i)])
+        steps = 0
+        while not h.done and steps < 64:
+            shard.step()
+            steps += 1
+        assert h.committed, f"probe txn aborted: {h.abort_reason}"
+        return shard.dispatches - d0, _t.perf_counter() - t0, steps
+
+    req = [0] * G
+    def probe_put(i):
+        g = i % G
+        key = pools[g][n_probe + 1]
+        req[g] += 1
+        conn = kv.conn_for(CID, g)
+        d0, t0 = shard.dispatches, _t.perf_counter()
+        kv.put(key, b"p%d" % i, client_id=CID, req_id=req[g])
+        steps = 0
+        while steps < 64:
+            shard.step()
+            steps += 1
+            kv.groups[g]._fold(shard.leader_hint(g))
+            if kv.groups[g].last_req[
+                    shard.leader_hint(g)].get(conn, 0) >= req[g]:
+                break
+        return shard.dispatches - d0, _t.perf_counter() - t0, steps
+
+    # warmup: compile the txn-lane program + settle leaders before
+    # timing (the probes report steady-state dispatch counts)
+    h = kv.transact([("put", pools[0][n_probe], b"w"),
+                     ("put", pools[1][n_probe], b"w")])
+    for _ in range(8):
+        if h.done:
+            break
+        shard.step()
+    for g in range(G):      # first fold compiles each group's apply
+        kv.put(pools[g][n_probe], b"w", client_id=CID, req_id=1)
+        req[g] = 1
+    shard.step()
+    for g in range(G):
+        kv.groups[g]._fold(shard.leader_hint(g))
+
+    twopc = [probe_2pc(i) for i in range(n_probe)]
+    single = [probe_put(i) for i in range(n_probe)]
+    mean = lambda xs: sum(xs) / len(xs)
+    probe = dict(
+        twopc=dict(dispatches=round(mean([d for d, _, _ in twopc]), 2),
+                   seconds=round(mean([s for _, s, _ in twopc]), 5),
+                   steps=round(mean([st for _, _, st in twopc]), 2)),
+        single=dict(dispatches=round(mean([d for d, _, _ in single]), 2),
+                    seconds=round(mean([s for _, s, _ in single]), 5),
+                    steps=round(mean([st for _, _, st in single]), 2)))
+    probe["latency_ratio"] = round(
+        probe["twopc"]["seconds"]
+        / max(probe["single"]["seconds"], 1e-9), 2)
+
+    # ---- throughput A/B: mergeable fast path vs plain puts ----
+    # one op in flight per key slot (64-way closed loop); merge keys
+    # and plain keys are the same set, so routing and fold cost match
+    mkeys = [pools[i % G][n_probe + 2 + i // G]
+             for i in range(n_keys)]
+    mreq = [0] * G
+
+    def run_round(variant, rep):
+        rng = _random.Random(f"txnbench:{seed}:{rep}")
+        order = [rng.randrange(n_keys) for _ in range(n_ops)]
+        slot_busy = [None] * n_keys      # handle | (g, req) in flight
+        i = done = steps = 0
+        t0 = _t.perf_counter()
+        while done < n_ops:
+            budget = B
+            while i < len(order) and budget > 0:
+                k = order[i]
+                if slot_busy[k] is not None:
+                    break               # keep per-key FIFO: wait
+                key = mkeys[k]
+                if variant == "merge":
+                    slot_busy[k] = kv.transact([("incr", key, 1)])
+                else:
+                    g = kv.group_of(key)
+                    mreq[g] += 1
+                    kv.put(key, b"v%d" % i, client_id=CID + 1,
+                           req_id=mreq[g])
+                    slot_busy[k] = (g, mreq[g])
+                i += 1
+                budget -= 1
+            shard.step()
+            steps += 1
+            marks = {}
+            for k, st in enumerate(slot_busy):
+                if st is None:
+                    continue
+                if variant == "merge":
+                    if st.done:
+                        assert st.committed
+                        slot_busy[k] = None
+                        done += 1
+                else:
+                    g, q = st
+                    if g not in marks:
+                        lead = shard.leader_hint(g)
+                        kv.groups[g]._fold(lead)
+                        marks[g] = kv.groups[g].last_req[lead]
+                    if marks[g].get(kv.conn_for(CID + 1, g), 0) >= q:
+                        slot_busy[k] = None
+                        done += 1
+        dt = _t.perf_counter() - t0
+        return dict(seconds=round(dt, 4), steps=steps, writes=done,
+                    write_ops_per_sec=round(done / dt, 1))
+
+    best = {"plain": None, "merge": None}
+    for rep in range(repeats):
+        for variant in ("plain", "merge"):
+            r = run_round(variant, rep)
+            if (best[variant] is None
+                    or r["write_ops_per_sec"]
+                    > best[variant]["write_ops_per_sec"]):
+                best[variant] = r
+    ratio = round(best["merge"]["write_ops_per_sec"]
+                  / max(best["plain"]["write_ops_per_sec"], 1e-9), 3)
+    coord = shard.txn.health()
+    return dict(n_groups=G, n_probe=n_probe, n_ops=n_ops,
+                n_keys=n_keys, repeats=repeats, seed=seed,
+                probe=probe, plain=best["plain"],
+                merge=best["merge"], merge_throughput_ratio=ratio,
+                coordinator=coord)
+
+
 def client_worker(port, n, lat, tid, pipeline=1, retries=5):
     """Pipelined client (the redis-benchmark -P analog): P commands per
     write — the app's read() picks them up as ONE buffer, so they ride a
@@ -920,6 +1100,17 @@ def main():
                          "watch_fanout_events_per_sec / "
                          "cdc_lag_entries and a "
                          "watch_attach_overhead_pct row (target <3%%)")
+    ap.add_argument("--txn", action="store_true",
+                    help="transaction bench: serial dispatch-count "
+                         "probes proving a cross-group 2PC commit "
+                         "resolves in ~2 dispatches (vs 1 for a "
+                         "single-key put), plus a seeded alternating "
+                         "best-of A/B of mergeable INCR transactions "
+                         "vs plain single-key puts — emits "
+                         "txn_commit_dispatches / "
+                         "txn_commit_latency_ratio / "
+                         "txn_merge_throughput_ratio rows "
+                         "(target >=0.9x)")
     ap.add_argument("--telemetry", action="store_true",
                     help="device telemetry: compile the counter-vector "
                          "step variants (obs/device.py), export "
@@ -1511,6 +1702,28 @@ def main():
              detail=wm["cdc"], obs=driver.obs, json_path=args.json)
         emit("watch_attach_overhead_pct",
              wm["watch_attach_overhead_pct"], "%", detail=wm,
+             obs=driver.obs, json_path=args.json)
+
+    if args.txn:
+        # on the now-quiet process (the --read-ratio reasoning): the
+        # probes count dispatches on a dedicated txn=True geometry,
+        # and the A/B isolates the fast path's cost on the write path
+        tm = measure_txn()
+        pr = tm["probe"]
+        print(f"txn: cross-group 2PC commit = "
+              f"{pr['twopc']['dispatches']} dispatches "
+              f"(single-key put = {pr['single']['dispatches']}), "
+              f"latency ratio {pr['latency_ratio']}x; mergeable "
+              f"{tm['merge']['write_ops_per_sec']:.0f} ops/s vs "
+              f"plain {tm['plain']['write_ops_per_sec']:.0f} ops/s "
+              f"-> {tm['merge_throughput_ratio']}x (target >=0.9x)")
+        emit("txn_commit_dispatches", pr["twopc"]["dispatches"],
+             "dispatches", detail=pr, obs=driver.obs,
+             json_path=args.json)
+        emit("txn_commit_latency_ratio", pr["latency_ratio"], "x",
+             detail=pr, obs=driver.obs, json_path=args.json)
+        emit("txn_merge_throughput_ratio",
+             tm["merge_throughput_ratio"], "x", detail=tm,
              obs=driver.obs, json_path=args.json)
 
     if args.serve_metrics is not None:

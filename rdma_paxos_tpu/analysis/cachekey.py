@@ -37,7 +37,7 @@ PASS_ID = "cache-key"
 # are read in a builder (new flags get added HERE, once)
 STATIC_FLAGS: Set[str] = {
     "cfg", "R", "_mode", "_use_pallas", "_interpret", "_fanout",
-    "_audit", "_telemetry", "_mesh_key",
+    "_audit", "_telemetry", "_mesh_key", "_txn",
 }
 
 # reads that are legitimately NOT in the key because another key

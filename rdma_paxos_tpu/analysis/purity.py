@@ -26,6 +26,7 @@ DEVICE_MODULES = (
     "rdma_paxos_tpu/ops/__init__.py",
     "rdma_paxos_tpu/ops/quorum.py",
     "rdma_paxos_tpu/parallel/mesh.py",
+    "rdma_paxos_tpu/txn/lane.py",
 )
 
 # no module reachable from a device module may come from these: host

@@ -112,6 +112,14 @@ class StepInput:
                              #   (rides the control gather; feeds the
                              #   burst-size hint every host computes
                              #   identically)
+    # --- cross-group transaction commit lane (txn=True only) ---
+    # None in the default program: None leaves add no pytree nodes, so
+    # txn=False inputs (and programs) are BYTE-IDENTICAL to the
+    # pre-txn step (cache-key guarded by tests/test_txn.py). The watch
+    # is this group's outstanding PREPARE entry in LOG-OFFSET domain
+    # (the host subtracts its rebase total); -1 = no watch armed.
+    txn_watch: Optional[jax.Array] = None   # i32 — prepare log offset
+    txn_term: Optional[jax.Array] = None    # i32 — term it was appended in
 
 
 @jax.tree_util.register_dataclass
@@ -169,6 +177,12 @@ class StepOutput:
     # program — telemetry=False steps stay byte-identical
     # (cache-key guarded by tests/test_device_obs.py).
     telemetry: Optional[jax.Array] = None
+    # --- cross-group transaction lane (txn=True only) ---
+    # i32 prepare vote (txn/lane.py constants) for the group's armed
+    # watch, evaluated against THIS replica's post-absorb log. None in
+    # the default program — txn=False steps stay byte-identical
+    # (cache-key guarded by tests/test_txn.py).
+    txn_vote: Optional[jax.Array] = None
 
 
 def make_step_input(cfg: LogConfig, n_replicas: int) -> StepInput:
@@ -279,6 +293,7 @@ def replica_step(
     elections: bool = True,
     audit: bool = False,
     telemetry: bool = False,
+    txn: bool = False,
 ) -> Tuple[ReplicaState, StepOutput]:
     """One protocol step for this replica (call under ``shard_map`` over the
     ``replica`` mesh axis, or under ``vmap(axis_name=...)`` for single-chip
@@ -871,6 +886,33 @@ def replica_step(
             ((cfg.n_slots - 1) - (end3 - head2)).astype(i32),
         ]).astype(jnp.uint32)
 
+    # ------------------------------------------------------------------
+    # Cross-group transaction prepare-vote lane (txn=True only;
+    # statically removed otherwise — the default program stays
+    # byte-identical). The host coordinator arms a per-group watch
+    # ``(prepare index, term)``; each replica reads the watched slot of
+    # its OWN post-absorb log and votes (txn/lane.py): PREPARED when
+    # the index committed under the watched term (or was already
+    # pruned — pruning trails the host apply cursor, so a pruned index
+    # was committed and replayed), CONFLICT when it committed under a
+    # different term (a failover leader overwrote the prepare), else
+    # PENDING. One gather-free slot read per replica — the vote rides
+    # the SAME dispatch that replicated the prepare entries, which is
+    # what makes a cross-group commit ~2 protocol steps.
+    # ------------------------------------------------------------------
+    txn_vote = None
+    if txn:
+        from rdma_paxos_tpu.txn.lane import prepare_vote
+        t_w = (inp.txn_watch if inp.txn_watch is not None
+               else jnp.full((), -1, i32))
+        t_wt = (inp.txn_term if inp.txn_term is not None
+                else jnp.zeros((), i32))
+        t_row = log3.buf[slot_of(jnp.maximum(t_w, 0), cfg.n_slots)]
+        txn_vote = prepare_vote(
+            watch=t_w, watch_term=t_wt, head=head2, commit=commit2,
+            entry_term=t_row[cfg.slot_words + M_TERM].astype(i32),
+            entry_gidx=t_row[cfg.slot_words + M_GIDX].astype(i32))
+
     new_state = ReplicaState(
         log=log3, term=new_term2, role=role2, leader_id=leader_id2,
         voted_term=new_voted_term, voted_for=new_voted_for,
@@ -925,6 +967,7 @@ def replica_step(
         audit_digest=audit_digest,
         audit_term=audit_terms,
         telemetry=telemetry_vec,
+        txn_vote=txn_vote,
     )
     return new_state, out
 
@@ -940,6 +983,7 @@ def group_step(
     elections: bool = True,
     audit: bool = False,
     telemetry: bool = False,
+    txn: bool = False,
 ):
     """The group-batched protocol step: G independent consensus groups
     advanced by ONE program.
@@ -972,7 +1016,7 @@ def group_step(
         replica_step, cfg=cfg, n_replicas=n_replicas,
         axis_name=axis_name, use_pallas=use_pallas,
         interpret=interpret, fanout=fanout, elections=elections,
-        audit=audit, telemetry=telemetry)
+        audit=audit, telemetry=telemetry, txn=txn)
     vstep = jax.vmap(core, in_axes=(0, 0), axis_name=axis_name)
     return jax.vmap(vstep, in_axes=(0, 0))
 

@@ -22,7 +22,16 @@ payloads) and a committed batch applies under ``lax.scan`` — so in
 standalone mode the whole service is jit-compiled end to end.
 
 Command encoding (int32 words): ``[op, key[KEY_W], val[VAL_W]]``,
-op ∈ {1=PUT, 2=GET, 3=RM}.
+op ∈ {1=PUT, 2=GET, 3=RM, 4=INCR, 5=SADD, 6=MAX}.
+
+Ops 4-6 are the MERGEABLE family (the txn/ fast path, after SafarDB's
+replicated-data-type commits): each is a commutative, associative fold
+of the operand into the current value — elementwise i32 add (INCR),
+bitwise-OR set union over the 256 value bits (SADD), elementwise max
+(MAX) — so concurrent merges to one key converge regardless of log
+interleaving and a cross-group transaction of only-mergeable writes
+commits as independent per-group entries with NO prepare phase.
+An absent key folds against zeros (the family's identity).
 """
 
 from __future__ import annotations
@@ -35,6 +44,7 @@ import jax.numpy as jnp
 import numpy as np
 
 OP_PUT, OP_GET, OP_RM = 1, 2, 3
+OP_INCR, OP_SADD, OP_MAX = 4, 5, 6
 KEY_W, VAL_W = 8, 8
 CMD_W = 1 + KEY_W + VAL_W
 PROBES = 32   # probe depth bounds the usable load factor (~0.5 is safe)
@@ -104,10 +114,21 @@ def apply_cmd(kv: KVState, cmd: jax.Array) -> Tuple[KVState, jax.Array]:
     mslot, fslot = _find(kv, key)
 
     target = jnp.where(mslot >= 0, mslot, fslot)
-    do_put = (op == OP_PUT) & (target >= 0)
+    # mergeable family: fold the operand into the CURRENT value — read
+    # through mslot only (an RM tombstone leaves stale words at free
+    # slots, so the absent-key identity must be zeros, never vals[t])
+    m0 = jnp.maximum(mslot, 0)
+    base = jnp.where(mslot >= 0, kv.vals[m0],
+                     jnp.zeros((VAL_W,), jnp.int32))
+    is_merge = (op == OP_INCR) | (op == OP_SADD) | (op == OP_MAX)
+    merged = jnp.where(
+        op == OP_INCR, base + val,
+        jnp.where(op == OP_SADD, base | val, jnp.maximum(base, val)))
+    do_put = ((op == OP_PUT) | is_merge) & (target >= 0)
+    wval = jnp.where(is_merge, merged, val)
     t = jnp.maximum(target, 0)
     keys = kv.keys.at[t].set(jnp.where(do_put, key, kv.keys[t]))
-    vals = kv.vals.at[t].set(jnp.where(do_put, val, kv.vals[t]))
+    vals = kv.vals.at[t].set(jnp.where(do_put, wval, kv.vals[t]))
     used = kv.used.at[t].set(jnp.where(do_put, 1, kv.used[t]))
 
     do_rm = (op == OP_RM) & (mslot >= 0)

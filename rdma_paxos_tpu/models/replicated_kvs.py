@@ -27,6 +27,8 @@ from rdma_paxos_tpu.consensus.log import EntryType
 from rdma_paxos_tpu.models.kvs import (
     CMD_W, OP_GET, OP_PUT, OP_RM, KVState, apply_cmd, decode_val,
     encode_cmd, make_kvs)
+from rdma_paxos_tpu.txn.records import (
+    TXN_ABORT, TXN_CMD_W, TXN_COMMIT, TXN_PREPARE)
 from rdma_paxos_tpu.runtime.sim import SimCluster
 
 
@@ -66,6 +68,15 @@ class ReplicatedKVS:
         # GETs, retransmits) is recorded as invoke/ok/fail events for
         # the linearizability checker. Host-side bookkeeping only.
         self.history = None
+        # 2PC staging (txn/records.py): per-replica tid -> buffered
+        # kvs-command words, folded DETERMINISTICALLY from the
+        # committed stream like last_req — a PREPARE record stages its
+        # embedded write here, the COMMIT record applies the buffer in
+        # staging order, ABORT drops it. Writes of an aborted (or
+        # never-decided) transaction therefore never reach the table.
+        self._txn_buf: List[dict] = [dict() for _ in range(cluster.R)]
+        self.txn_applied: List[int] = [0] * cluster.R
+        self.txn_discarded: List[int] = [0] * cluster.R
 
     def _spans(self):
         """The cluster's span recorder when causal tracing is on —
@@ -97,6 +108,9 @@ class ReplicatedKVS:
         self._cursor[r] = 0
         self.last_req[r] = dict()
         self.deduped[r] = 0
+        self._txn_buf[r] = dict()
+        self.txn_applied[r] = 0
+        self.txn_discarded[r] = 0
 
     # ------------------------------------------------------------------
 
@@ -121,6 +135,13 @@ class ReplicatedKVS:
         for etype, conn, req, payload in rows:
             if etype != int(EntryType.SEND):
                 continue
+            if len(payload) == TXN_CMD_W * 4:
+                # 2PC record — the distinct width keeps legacy folds
+                # skipping it; the (conn, req) dedup rule below covers
+                # it in _fold_txn, so a coordinator retransmit after
+                # failover stages/decides exactly once
+                self._fold_txn(r, conn, req, payload)
+                continue
             if len(payload) != CMD_W * 4:
                 continue                      # not a KVS command: skip
             if req > 0 and conn > 0:
@@ -140,6 +161,31 @@ class ReplicatedKVS:
             cmd = jnp.asarray(np.frombuffer(payload, "<i4"))
             self.tables[r], _ = self._apply_jit(self.tables[r], cmd)
 
+    def _fold_txn(self, r: int, conn: int, req: int,
+                  payload: bytes) -> None:
+        """Fold one committed 2PC record (txn/records.py layout):
+        PREPARE stages its embedded write per tid, COMMIT applies the
+        tid's staged writes in order, ABORT drops them. Deterministic
+        over the committed stream (same dedup rule as commands), so
+        every replica — and any rebuild — derives the same table."""
+        from rdma_paxos_tpu.txn.records import decode_record
+        if req > 0 and conn > 0:
+            if req <= self.last_req[r].get(conn, 0):
+                self.deduped[r] += 1
+                return
+            self.last_req[r][conn] = req
+        txn_op, tid, _arg, cmd_words = decode_record(payload)
+        buf = self._txn_buf[r]
+        if txn_op == TXN_PREPARE:
+            buf.setdefault(tid, []).append(np.asarray(cmd_words))
+        elif txn_op == TXN_COMMIT:
+            for cmd in buf.pop(tid, ()):
+                self.tables[r], _ = self._apply_jit(
+                    self.tables[r], jnp.asarray(cmd))
+                self.txn_applied[r] += 1
+        elif txn_op == TXN_ABORT:
+            self.txn_discarded[r] += len(buf.pop(tid, ()))
+
     # ------------------------------------------------------------------
 
     def put(self, leader: int, key: bytes, val: bytes, *,
@@ -150,6 +196,13 @@ class ReplicatedKVS:
     def remove(self, leader: int, key: bytes, *,
                client_id: int = 0, req_id: int = 0) -> None:
         self.c.submit(leader, encode_cmd(OP_RM, key).tobytes(),
+                      conn=client_id, req_id=req_id)
+
+    def merge(self, leader: int, op: int, key: bytes, val: bytes, *,
+              client_id: int = 0, req_id: int = 0) -> None:
+        """Submit one mergeable write (OP_INCR/OP_SADD/OP_MAX) — a
+        plain single-group command; the txn fast path rides these."""
+        self.c.submit(leader, encode_cmd(op, key, val).tobytes(),
                       conn=client_id, req_id=req_id)
 
     def session(self, client_id: int) -> "ClientSession":
@@ -347,6 +400,23 @@ class ClientSession:
                         self.kvs._span_rep(leader), phase="submit")
         self.kvs.remove(leader, key, client_id=self.client_id,
                         req_id=self.req_id)
+        return self.req_id
+
+    def merge(self, leader: int, op: int, key: bytes,
+              val: bytes) -> int:
+        """Submit a stamped mergeable write (same exactly-once
+        contract as :meth:`put` — one outstanding req per session)."""
+        self.req_id += 1
+        if self.kvs.history is not None:
+            self.kvs.history.invoke("merge", key, val,
+                                    client=self.client_id,
+                                    req_id=self.req_id, replica=leader)
+        spans = self.kvs._spans()
+        if spans is not None:
+            spans.begin(self.client_id, self.req_id,
+                        self.kvs._span_rep(leader), phase="submit")
+        self.kvs.merge(leader, op, key, val, client_id=self.client_id,
+                       req_id=self.req_id)
         return self.req_id
 
     def retransmit_put(self, leader: int, key: bytes, val: bytes,

@@ -87,7 +87,8 @@ def default_rules(*, commit_p99_ceiling_s: float = 0.5,
                   burn_fast_s: float = 30.0,
                   burn_slow_s: float = 300.0,
                   burn_threshold: float = 6.0,
-                  cdc_lag_ceiling: int = 4096) -> List[dict]:
+                  cdc_lag_ceiling: int = 4096,
+                  txn_abort_rate: int = 3) -> List[dict]:
     """The stock SLO rule set: digest mismatch pages immediately (a
     correctness violation, not a performance blip); sustained
     leaderlessness pages; commit-latency p99 above the ceiling and a
@@ -164,6 +165,14 @@ def default_rules(*, commit_p99_ceiling_s: float = 0.5,
         dict(name="cdc_backpressure", severity=WARN, kind="gauge_cmp",
              metric="cdc_lag_entries", op=">", value=cdc_lag_ceiling,
              agg="max", for_evals=2),
+        # more than txn_abort_rate transaction aborts (any reason —
+        # conflict, timeout, failover) between two evaluations,
+        # sustained: the commit lane is thrashing (hot-key contention
+        # or leadership churn eating the 2PC window). Silent on
+        # clusters without a coordinator (counter never exists).
+        dict(name="txn_abort_rate", severity=WARN, kind="counter_rate",
+             metric="txn_aborted_total", threshold=txn_abort_rate,
+             for_evals=2),
     ]
 
 

@@ -145,6 +145,20 @@ def _repair_state(health: dict) -> str:
                     for k, st in sorted(active.items()))
 
 
+def _txn_state(health: dict) -> str:
+    """Coordinator column: lifetime committed/aborted totals plus the
+    in-flight count (``txn`` health entry — absent on clusters
+    without a coordinator)."""
+    txn = health.get("txn")
+    if not txn:
+        return "-"
+    aborts = sum((txn.get("aborted_total") or {}).values())
+    s = f"{txn.get('committed_total', 0)}c/{aborts}a"
+    if txn.get("active"):
+        s += f" {txn['active']}live"
+    return s
+
+
 def _firing_alerts(state: Optional[dict]) -> List[dict]:
     out = []
     for name, st in (state or {}).items():
@@ -197,7 +211,8 @@ def fleet_view(sources: List[dict]) -> dict:
                     commit=_imax(grp.get("commit") or []),
                     apply=_imax(grp.get("apply") or []),
                     reads=(reads if g == 0 else {}),
-                    repair=_repair_state(h)))
+                    repair=_repair_state(h),
+                    txn=(_txn_state(h) if g == 0 else "-")))
         elif isinstance(h.get("replicas"), list):   # single-group
             hosts.append(dict(src=src, kind="cluster", age_s=age,
                               loop_error=h.get("loop_error")))
@@ -210,7 +225,8 @@ def fleet_view(sources: List[dict]) -> dict:
                 commit=_imax(r.get("commit") for r in reps),
                 apply=_imax(r.get("apply") for r in reps),
                 reads=_reads_by_path(h),
-                repair=_repair_state(h)))
+                repair=_repair_state(h),
+                txn=_txn_state(h)))
         elif "replica" in h:                        # one member file
             hosts.append(dict(src=src, kind="replica",
                               replica=h.get("replica"), age_s=age))
@@ -232,7 +248,7 @@ def fleet_view(sources: List[dict]) -> dict:
             term=_imax(h.get("term") for _, h in members),
             commit=_imax(h.get("commit") for _, h in members),
             apply=_imax(h.get("apply") for _, h in members),
-            reads={}, repair="-",
+            reads={}, repair="-", txn="-",
             members=len(members)))
 
     # dedupe alerts by name, keeping the longest-firing instance
@@ -269,7 +285,8 @@ def render_table(view: dict, prev: Optional[dict] = None) -> str:
         for r in prev["groups"]:
             prev_reads[(r["src"], r["group"])] = r["reads"]
     hdr = (f"{'GROUP':<6} {'LEADER':<7} {'LEASE':<6} {'TERM':<6} "
-           f"{'COMMIT':<10} {'APPLY':<10} {'REPAIR':<14} READS")
+           f"{'COMMIT':<10} {'APPLY':<10} {'REPAIR':<14} "
+           f"{'TXN':<12} READS")
     lines = [hdr, "-" * len(hdr)]
     for r in view["groups"]:
         def cell(v, dash="-"):
@@ -279,6 +296,7 @@ def render_table(view: dict, prev: Optional[dict] = None) -> str:
             f"{cell(r['lease']):<6} {cell(r['term']):<6} "
             f"{cell(r['commit']):<10} {cell(r['apply']):<10} "
             f"{str(r['repair']):<14} "
+            f"{str(r.get('txn', '-')):<12} "
             + _fmt_reads(r["reads"],
                          prev_reads.get((r["src"], r["group"])), dt))
     if view["alerts"]:

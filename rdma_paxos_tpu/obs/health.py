@@ -45,6 +45,7 @@ CLUSTER_HEALTH_FIELDS = (
     "leases",                # LeaseManager.status() or None
     "reads",                 # ReadHub.status() or None
     "streams",               # StreamHub.status() or None
+    "txn",                   # TxnCoordinator.health() or None
     "ts",
 )
 

@@ -117,7 +117,7 @@ def build_spmd_step(cfg: LogConfig, n_replicas: int, mesh: Mesh, *,
                     use_pallas: bool = False, interpret: bool = False,
                     donate: bool = True, fanout: str = "gather",
                     elections: bool = True, audit: bool = False,
-                    telemetry: bool = False):
+                    telemetry: bool = False, txn: bool = False):
     """Compile the protocol step over a real device mesh.
 
     Takes/returns *batched* pytrees (leading ``replica`` axis, sharded one
@@ -130,7 +130,7 @@ def build_spmd_step(cfg: LogConfig, n_replicas: int, mesh: Mesh, *,
         replica_step, cfg=cfg, n_replicas=n_replicas,
         axis_name=REPLICA_AXIS, use_pallas=use_pallas, interpret=interpret,
         fanout=fanout, elections=elections, audit=audit,
-        telemetry=telemetry)
+        telemetry=telemetry, txn=txn)
 
     def per_device(state_b, inp_b):
         st, out = core(_squeeze(state_b), _squeeze(inp_b))
@@ -508,7 +508,7 @@ def build_sim_group_step(cfg: LogConfig, n_replicas: int, *,
                          use_pallas: bool = False, interpret: bool = False,
                          donate: bool = True, fanout: str = "gather",
                          elections: bool = True, audit: bool = False,
-                         telemetry: bool = False):
+                         telemetry: bool = False, txn: bool = False):
     """Compile the G-group × R-replica protocol step as ONE program on
     one device (:func:`rdma_paxos_tpu.consensus.step.group_step` under
     ``jit``). The group axis is an unnamed batch axis — groups are
@@ -519,7 +519,7 @@ def build_sim_group_step(cfg: LogConfig, n_replicas: int, *,
                         axis_name=REPLICA_AXIS, use_pallas=use_pallas,
                         interpret=interpret, fanout=fanout,
                         elections=elections, audit=audit,
-                        telemetry=telemetry)
+                        telemetry=telemetry, txn=txn)
     return jax.jit(mapped, donate_argnums=(0,) if donate else ())
 
 
@@ -566,7 +566,7 @@ def build_spmd_group_step(cfg: LogConfig, n_replicas: int, mesh: Mesh,
                           interpret: bool = False, donate: bool = True,
                           fanout: str = "gather",
                           elections: bool = True, audit: bool = False,
-                          telemetry: bool = False):
+                          telemetry: bool = False, txn: bool = False):
     """:func:`build_sim_group_step` over a REAL 2-D ``(group,
     replica)`` device mesh (:func:`build_mesh_2d`): G groups × R
     replicas advanced by ONE ``shard_map``-compiled dispatch spanning
@@ -587,7 +587,7 @@ def build_spmd_group_step(cfg: LogConfig, n_replicas: int, mesh: Mesh,
         axis_name=REPLICA_AXIS, use_pallas=use_pallas,
         interpret=interpret, fanout=fanout, elections=elections,
         audit=audit,
-        telemetry=telemetry)
+        telemetry=telemetry, txn=txn)
     vcore = jax.vmap(core, in_axes=(0, 0))      # local groups, unnamed
 
     def per_device(state_b, inp_b):
@@ -665,13 +665,13 @@ def build_sim_step(cfg: LogConfig, n_replicas: int, *,
                    use_pallas: bool = False, interpret: bool = False,
                    donate: bool = True, fanout: str = "gather",
                    elections: bool = True, audit: bool = False,
-                   telemetry: bool = False):
+                   telemetry: bool = False, txn: bool = False):
     """Compile the protocol step as an N-replica simulation on one device
     (``vmap`` with a named axis — identical collective semantics)."""
     core = functools.partial(
         replica_step, cfg=cfg, n_replicas=n_replicas,
         axis_name=REPLICA_AXIS, use_pallas=use_pallas, interpret=interpret,
         fanout=fanout, elections=elections, audit=audit,
-        telemetry=telemetry)
+        telemetry=telemetry, txn=txn)
     mapped = jax.vmap(core, in_axes=(0, 0), axis_name=REPLICA_AXIS)
     return jax.jit(mapped, donate_argnums=(0,) if donate else ())

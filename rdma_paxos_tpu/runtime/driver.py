@@ -127,6 +127,7 @@ class ClusterDriver:
                  series_capacity: int = 1280,
                  metrics_port: Optional[int] = None,
                  scan: bool = False,
+                 txn: bool = False,
                  governor: bool = False,
                  governor_opts: Optional[Dict] = None,
                  idle_quiesce: bool = True,
@@ -141,6 +142,12 @@ class ClusterDriver:
         # (driver.cluster.scan) — the host_path A/B flips it between
         # rounds; scan-off runs compile no scan programs.
         self._scan = bool(scan)
+        # txn=True compiles the transaction vote-lane step variants
+        # (txn/lane.py) so a coordinator can be attached
+        # (txn.attach_coordinator over a ShardedKVS on this cluster);
+        # txn=False programs and cache keys are bit-identical to the
+        # unflagged world (tests/test_txn.py pins it)
+        self._txn_flag = bool(txn)
         self.sync_period = sync_period
         self._workdir = workdir
         # observability: one registry + trace ring + span recorder per
@@ -203,7 +210,8 @@ class ClusterDriver:
         # signals the telemetry-backed alert rules read
         self._telemetry = telemetry
         self.cluster = self._make_cluster(cfg, n_replicas, group_size,
-                                          mode, fanout, audit, telemetry)
+                                          mode, fanout, audit, telemetry,
+                                          self._txn_flag)
         self.cluster.obs = self.obs
         self.cluster.profiler = self._phase_prof
         # read scaling (runtime/reads.py): step-domain leader leases
@@ -419,12 +427,13 @@ class ClusterDriver:
             self.serve_metrics(self._metrics_port)
 
     def _make_cluster(self, cfg, n_replicas, group_size, mode, fanout,
-                      audit, telemetry):
+                      audit, telemetry, txn=False):
         """Engine factory (the sharded driver subclass overrides this
         to serve a multi-group ShardedCluster through the same loop)."""
         return SimCluster(cfg, n_replicas, group_size, mode=mode,
                           fanout=fanout, audit=audit,
-                          telemetry=telemetry, scan=self._scan)
+                          telemetry=telemetry, scan=self._scan,
+                          txn=txn)
 
     def _wire_repair(self) -> None:
         """Single-group driver: repair installs ride
@@ -693,6 +702,8 @@ class ClusterDriver:
         if (depose < 0
                 and self._leader_view >= 0 and self.cluster.last is not None
                 and self._backlog()
+                and not (self.cluster.txn is not None
+                         and self.cluster.txn.wants_serial())
                 and (dec is None or dec.max_k > 1)):
             self._timer_obs.start("device_step")
             res = self.cluster.step_burst(
@@ -1053,6 +1064,8 @@ class ClusterDriver:
                      if self.cluster.streams is not None else None),
             governor=(self.governor.status()
                       if self.governor is not None else None),
+            txn=(self.cluster.txn.health()
+                 if self.cluster.txn is not None else None),
         )
 
     # ------------------------------------------------------------------
@@ -1619,7 +1632,11 @@ class ClusterDriver:
                         # queued reads need steps to confirm/serve —
                         # keep the loop running until they resolve
                         or (self.cluster.reads is not None
-                            and self.cluster.reads.pending_count()))
+                            and self.cluster.reads.pending_count())
+                        # in-flight transactions decide off the
+                        # finish() tail — keep stepping until then
+                        or (self.cluster.txn is not None
+                            and self.cluster.txn.wants_serial()))
 
     # holds-lock: _lock
     def _waiter_count(self) -> int:
@@ -1654,6 +1671,11 @@ class ClusterDriver:
         # the rebase is deferred until the pipeline drains, and the
         # headroom margin covers only boundedly many in-flight bursts
         if int(c.last["end"].max()) >= self.cfg.rebase_threshold:
+            return False
+        # an in-flight transaction holds the commit lane: votes and
+        # decision records ride SERIAL dispatches only (the same
+        # give-way rule elections and repair follow)
+        if c.txn is not None and c.txn.wants_serial():
             return False
         # the governor engages/disengages depth-D pipelining: until
         # backlog has STOOD for engage_evals (or while shedding), the

@@ -142,12 +142,12 @@ class ShardedClusterDriver(ClusterDriver):
         self._elect_round = [0] * self.G
 
     def _make_cluster(self, cfg, n_replicas, group_size, mode, fanout,
-                      audit, telemetry):
+                      audit, telemetry, txn=False):
         return ShardedCluster(cfg, n_replicas, self.G,
                               router=self._router, fanout=fanout,
                               group_size=group_size, audit=audit,
                               mesh=self._mesh, telemetry=telemetry,
-                              scan=self._scan)
+                              scan=self._scan, txn=txn)
 
     def _wire_repair(self) -> None:
         """Sharded driver: repair uses the controller's ENGINE-level
@@ -312,7 +312,11 @@ class ShardedClusterDriver(ClusterDriver):
             return bool(any(self._submitq) or self._backlog()
                         or self._waiter_count()
                         or (self.cluster.reads is not None
-                            and self.cluster.reads.pending_count()))
+                            and self.cluster.reads.pending_count())
+                        # in-flight transactions decide off the
+                        # finish() tail — keep stepping until then
+                        or (self.cluster.txn is not None
+                            and self.cluster.txn.wants_serial()))
 
     def step(self) -> Dict:
         """One host-loop iteration: elections for leaderless groups
@@ -356,6 +360,7 @@ class ShardedClusterDriver(ClusterDriver):
         if (not timeouts and c.last is not None
                 and all(v >= 0 for v in self._group_views)
                 and self._backlog()
+                and not (c.txn is not None and c.txn.wants_serial())
                 and (dec is None or dec.max_k > 1)):
             self._timer_obs.start("device_step")
             res = c.step_burst(max_k=dec.max_k if dec is not None
@@ -380,6 +385,11 @@ class ShardedClusterDriver(ClusterDriver):
         if self.repair is not None and self.repair.needs_drain():
             return False
         if int(c.last["end"].max()) >= self.cfg.rebase_threshold:
+            return False
+        # an in-flight transaction holds the commit lane: votes and
+        # decision records ride SERIAL dispatches (the same give-way
+        # rule elections and repair follow)
+        if c.txn is not None and c.txn.wants_serial():
             return False
         # the governor engages/disengages pipelining (see
         # ClusterDriver._pipeline_ready)
@@ -615,7 +625,9 @@ class ShardedClusterDriver(ClusterDriver):
             streams=(self.cluster.streams.status()
                      if self.cluster.streams is not None else None),
             governor=(self.governor.status()
-                      if self.governor is not None else None))
+                      if self.governor is not None else None),
+            txn=(self.cluster.txn.health()
+                 if self.cluster.txn is not None else None))
         return make_cluster_snapshot(**h)
 
     def read(self, fn=None, *, key=None, group: Optional[int] = None,

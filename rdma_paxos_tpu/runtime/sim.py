@@ -319,7 +319,8 @@ class SimCluster:
                  interpret: bool = False,
                  fanout: str = "gather", stable_fast_path: bool = True,
                  audit: bool = False, flight_capacity: int = 64,
-                 telemetry: bool = False, scan: bool = False):
+                 telemetry: bool = False, scan: bool = False,
+                 txn: bool = False):
         self.cfg = cfg
         # device-resident K-window scan tier (hostpath PR): with
         # scan=True, begin_burst dispatches the fused-scan program —
@@ -361,6 +362,15 @@ class SimCluster:
             self.device_counters = _device.zeros(n_replicas)
         else:
             self.device_counters = None
+        # cross-group transaction lane (txn/lane.py): txn=True compiles
+        # the prepare-vote step variants (distinct cache keys — default
+        # programs untouched, exactly the audit=/telemetry= discipline;
+        # tests/test_txn.py pins txn=False bit-identity). The armed
+        # watch is host state in the ABSOLUTE index domain; begin_step
+        # converts to the log-offset domain the device compares in.
+        self._txn = txn
+        self._txn_watch = -1      # absolute prepare index (-1 = clear)
+        self._txn_wterm = 0       # term the prepare was appended under
         # production default: the Pallas quorum kernel on TPU (same code
         # path as the benches), jnp reference scan elsewhere
         if use_pallas is None:
@@ -494,6 +504,12 @@ class SimCluster:
         # so it adds no STEP_CACHE keys (tests/test_governor.py pins
         # the ladder-only contract).
         self.governor = None
+        # cross-group 2PC coordinator (txn/coordinator.py, attached via
+        # txn.attach_coordinator): observed at the very tail of every
+        # finish() — after the governor, so admission demand it creates
+        # is next-step demand. Pure host bookkeeping; the device lane
+        # it reads rides the txn= step variant's cache keys only.
+        self.txn = None
         # replicas barred from SERVING reads by the repair pipeline
         # (digest quarantine AND the storm policy, whose holds leave
         # replay running and so never enter need_recovery) — consulted
@@ -536,6 +552,21 @@ class SimCluster:
         the pump under full windows)."""
         with self._host_lock:
             self.pending[replica].extend(entries)
+
+    def set_txn_watch(self, index: int, term: int) -> None:
+        """Arm the prepare watch: every subsequent serial step reports a
+        per-replica vote for whether ABSOLUTE log index ``index`` is
+        committed under ``term`` (txn=True clusters only). The watch is
+        sticky until :meth:`clear_txn_watch` — the coordinator re-reads
+        the vote matrix each step while a prepare is outstanding."""
+        if not self._txn:
+            raise RuntimeError("set_txn_watch requires txn=True")
+        self._txn_watch = int(index)
+        self._txn_wterm = int(term)
+
+    def clear_txn_watch(self) -> None:
+        self._txn_watch = -1
+        self._txn_wterm = 0
 
     def partition(self, groups: Sequence[Sequence[int]]) -> None:
         """Split the cluster: replicas hear only same-group peers."""
@@ -657,6 +688,14 @@ class SimCluster:
             peer_mask=jnp.asarray(mask),
             apply_done=jnp.asarray(applied),
             queue_depth=jnp.asarray(qdepth),
+            **(dict(
+                # device watch compares log offsets: shift the armed
+                # ABSOLUTE index by the i32 rollovers applied so far
+                txn_watch=jnp.full(
+                    (R,), (self._txn_watch - self.rebased_total
+                           if self._txn_watch >= 0 else -1), jnp.int32),
+                txn_term=jnp.full((R,), self._txn_wterm, jnp.int32),
+            ) if self._txn else {}),
         )
         # no timer fired ⟹ Phase B is provably a no-op: dispatch the
         # stable step (bit-identical outputs, one fewer collective)
@@ -793,6 +832,10 @@ class SimCluster:
         else:
             res = {k: np.asarray(getattr(out, k))
                    for k in self.RES_KEYS}
+            if self._txn and out.txn_vote is not None:
+                # serial dispatches only: the txn lane never rides
+                # burst/scan programs (their keys stay untouched)
+                res["txn_vote"] = np.asarray(out.txn_vote)
         if prof is not None:
             prof.stop("quorum_wait")
         if self._audit:
@@ -843,6 +886,10 @@ class SimCluster:
                 if take and res["role"][r] == int(Role.LEADER):
                     acc_r = int(res["accepted"][r])
                     self._stamp_appends(r, take, acc_r, res)
+                    if self.txn is not None and acc_r > 0:
+                        self.txn.note_appends(
+                            0, r, take[:acc_r], int(res["term"][r]),
+                            int(res["end"][r]) + self.rebased_total)
                     requeue_shortfall(self.pending[r], take, acc_r)
         if prof is not None:
             prof.start("apply")
@@ -877,6 +924,8 @@ class SimCluster:
             self.streams.observe(self, res)
         if self.governor is not None:
             self.governor.observe(self, res)
+        if self.txn is not None:
+            self.txn.observe(self, res)
         if burst or scan:
             B = self.cfg.batch_slots
             self._staging.release(ticket.bufs, [
@@ -969,13 +1018,14 @@ class SimCluster:
         key = (self.cfg, self.R, self._mode, self._use_pallas,
                self._interpret, self._fanout, elections) \
             + (("audit",) if self._audit else ()) \
-            + (("telemetry",) if self._telemetry else ())
+            + (("telemetry",) if self._telemetry else ()) \
+            + (("txn",) if self._txn else ())
         cached = self._STEP_CACHE.get(key)
         if cached is None:
             kw = dict(use_pallas=self._use_pallas,
                       interpret=self._interpret, fanout=self._fanout,
                       elections=elections, audit=self._audit,
-                      telemetry=self._telemetry)
+                      telemetry=self._telemetry, txn=self._txn)
             if self._mode == "spmd":
                 cached = build_spmd_step(self.cfg, self.R, self.mesh, **kw)
             else:
@@ -997,7 +1047,10 @@ class SimCluster:
             timeout_fired=jnp.zeros((R,), jnp.int32),
             peer_mask=jnp.asarray(self.peer_mask),
             apply_done=jnp.zeros((R,), jnp.int32),
-            queue_depth=jnp.zeros((R,), jnp.int32))
+            queue_depth=jnp.zeros((R,), jnp.int32),
+            **(dict(txn_watch=jnp.full((R,), -1, jnp.int32),
+                    txn_term=jnp.zeros((R,), jnp.int32))
+               if self._txn else {}))
         for elections in (True, False):
             fn = self._build_step(elections=elections)
             st = jax.tree.map(lambda x: x.copy(), self.state)

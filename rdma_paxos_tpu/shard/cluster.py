@@ -112,7 +112,7 @@ class ShardedCluster:
                  group_size: Optional[int] = None,
                  audit: bool = False, flight_capacity: int = 64,
                  mesh=None, telemetry: bool = False,
-                 scan: bool = False):
+                 scan: bool = False, txn: bool = False):
         if n_groups < 1:
             raise ValueError("n_groups must be >= 1")
         self.cfg = cfg
@@ -191,6 +191,17 @@ class ShardedCluster:
             self.device_counters = _device.zeros(self.G, self.R)
         else:
             self.device_counters = None
+        # cross-group transaction lane (txn/lane.py) — the SimCluster
+        # mechanism widened by the group axis: per-group prepare
+        # watches in the ABSOLUTE index domain (begin_step subtracts
+        # each group's rebased_total), votes read back as the stacked
+        # [G, R] matrix from the SAME dispatch that replicated the
+        # prepares. txn=True compiles distinct serial step variants
+        # (the audit=/telemetry= cache-key discipline); burst/scan
+        # programs never carry the lane.
+        self._txn = txn
+        self._txn_watch = np.full((self.G,), -1, np.int64)
+        self._txn_wterm = np.zeros((self.G,), np.int64)
         self.state = stack_group_states(cfg, self.G, self.R,
                                         self.group_size)
         if mesh is not None:
@@ -256,6 +267,11 @@ class ShardedCluster:
         # per-group rungs ride the trace events). Same attach pattern
         # and zero-new-STEP_CACHE-keys contract as SimCluster.
         self.governor = None
+        # cross-group 2PC coordinator (txn/coordinator.py, attached
+        # via txn.attach_coordinator): observed at the very tail of
+        # every finish(), after the governor — same contract as
+        # SimCluster. Host bookkeeping only.
+        self.txn = None
         # repair-held replicas barred from read serving ({(g, r)} —
         # see SimCluster.read_blocked)
         self.read_blocked: set = set()
@@ -303,6 +319,25 @@ class ShardedCluster:
         ``SimCluster.submit_many``."""
         with self._host_lock:
             self.pending[group][replica].extend(entries)
+
+    def set_txn_watch(self, group: int, index: int, term: int) -> None:
+        """Arm ``group``'s prepare watch: every subsequent serial step
+        reports the group's per-replica vote for whether ABSOLUTE log
+        index ``index`` is committed under ``term`` (txn=True clusters
+        only). Sticky until cleared — the coordinator re-reads the
+        ``[G, R]`` vote matrix each step while a prepare is out."""
+        if not self._txn:
+            raise RuntimeError("set_txn_watch requires txn=True")
+        self._txn_watch[group] = int(index)
+        self._txn_wterm[group] = int(term)
+
+    def clear_txn_watch(self, group: Optional[int] = None) -> None:
+        if group is None:
+            self._txn_watch[:] = -1
+            self._txn_wterm[:] = 0
+        else:
+            self._txn_watch[group] = -1
+            self._txn_wterm[group] = 0
 
     def partition(self, group: int,
                   groups_of_replicas: Sequence[Sequence[int]]) -> None:
@@ -390,13 +425,14 @@ class ShardedCluster:
                self._use_pallas, self._interpret, self._fanout,
                "group", elections) \
             + (("audit",) if self._audit else ()) \
-            + (("telemetry",) if self._telemetry else ())
+            + (("telemetry",) if self._telemetry else ()) \
+            + (("txn",) if self._txn else ())
         cached = STEP_CACHE.get(key)
         if cached is None:
             kw = dict(use_pallas=self._use_pallas,
                       interpret=self._interpret, fanout=self._fanout,
                       elections=elections, audit=self._audit,
-                      telemetry=self._telemetry)
+                      telemetry=self._telemetry, txn=self._txn)
             if self.mesh is not None:
                 cached = build_spmd_group_step(self.cfg, self.R,
                                                self.mesh, **kw)
@@ -466,7 +502,10 @@ class ShardedCluster:
             timeout_fired=jnp.zeros((G, R), jnp.int32),
             peer_mask=jnp.asarray(self.peer_mask),
             apply_done=jnp.zeros((G, R), jnp.int32),
-            queue_depth=jnp.zeros((G, R), jnp.int32))
+            queue_depth=jnp.zeros((G, R), jnp.int32),
+            **(dict(txn_watch=jnp.full((G, R), -1, jnp.int32),
+                    txn_term=jnp.zeros((G, R), jnp.int32))
+               if self._txn else {}))
         for elections in (True, False):
             fn, _ = self._build_step(elections=elections)
             st = jax.tree.map(lambda x: x.copy(), self.state)
@@ -536,6 +575,18 @@ class ShardedCluster:
             peer_mask=jnp.asarray(mask),
             apply_done=jnp.asarray(applied),
             queue_depth=jnp.asarray(qdepth),
+            **(dict(
+                # device watches compare log offsets: shift each armed
+                # ABSOLUTE index by that group's i32 rollovers, then
+                # broadcast across the replica axis
+                txn_watch=jnp.asarray(np.broadcast_to(
+                    np.where(self._txn_watch >= 0,
+                             self._txn_watch - self.rebased_total,
+                             -1)[:, None], (G, R)).astype(np.int32)),
+                txn_term=jnp.asarray(np.broadcast_to(
+                    self._txn_wterm[:, None],
+                    (G, R)).astype(np.int32)),
+            ) if self._txn else {}),
         )
         # no timer fired in ANY group ⟹ Phase B is provably a no-op
         # for every group: dispatch the stable step (bit-identical)
@@ -675,6 +726,10 @@ class ShardedCluster:
             res["accepted"] = acc
         else:
             res = {k: np.asarray(getattr(out, k)) for k in _RES_KEYS}
+            if self._txn and out.txn_vote is not None:
+                # serial dispatches only: the txn lane never rides
+                # burst/scan programs (their keys stay untouched)
+                res["txn_vote"] = np.asarray(out.txn_vote)
         if prof is not None:
             prof.stop("quorum_wait")
         if self._audit:
@@ -718,6 +773,12 @@ class ShardedCluster:
                     if take and res["role"][g, r] == int(Role.LEADER):
                         acc_gr = int(res["accepted"][g, r])
                         self._stamp_appends(g, r, take, acc_gr, res)
+                        if self.txn is not None and acc_gr > 0:
+                            self.txn.note_appends(
+                                g, r, take[:acc_gr],
+                                int(res["term"][g, r]),
+                                int(res["end"][g, r])
+                                + int(self.rebased_total[g]))
                         requeue_shortfall(self.pending[g][r], take,
                                           acc_gr)
         if prof is not None:
@@ -751,6 +812,8 @@ class ShardedCluster:
             self.streams.observe(self, res)
         if self.governor is not None:
             self.governor.observe(self, res)
+        if self.txn is not None:
+            self.txn.observe(self, res)
         if burst or scan:
             self._staging.release(ticket.bufs, [
                 ((k, g, r), min(B, len(t) - k * B))

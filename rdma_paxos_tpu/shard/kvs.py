@@ -193,6 +193,14 @@ class ShardedKVS:
     def session(self, client_id: int) -> "ShardedSession":
         return ShardedSession(self, client_id)
 
+    def transact(self, writes, reads=()):
+        """Admit one cross-group atomic transaction (txn/api.py):
+        ``writes`` are ``(op_name, key, value)`` triples, op_name in
+        {put, rm, incr, sadd, max}. Requires ``txn.attach_coordinator``
+        on a ``txn=True`` cluster. Returns a ``TxnHandle``."""
+        from rdma_paxos_tpu.txn.api import transact
+        return transact(self, writes, reads)
+
 
 class ShardedSession:
     """A retransmitting client over the sharded keyspace.

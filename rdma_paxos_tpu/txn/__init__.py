@@ -1,0 +1,47 @@
+"""Cross-group atomic transactions over the sharded consensus engine.
+
+Layout mirrors the device/host split the analysis passes enforce:
+
+* :mod:`rdma_paxos_tpu.txn.lane` — device-pure vote constants and the
+  prepare-vote rule compiled into the ``txn=`` step variant (the only
+  module ``consensus/step.py`` imports from this package).
+* :mod:`rdma_paxos_tpu.txn.coordinator` — the host 2PC state machine
+  (begin/prepare/commit/abort, step-domain timeouts, participant
+  locks, abort on leader failover).
+* :mod:`rdma_paxos_tpu.txn.api` — ``transact()``, the client surface
+  ``ShardedKVS`` exposes.
+* :mod:`rdma_paxos_tpu.txn.merge` — the mergeable-op fast path
+  (INCR / add-to-set / max-register commit as independent per-group
+  entries, no prepare).
+* :mod:`rdma_paxos_tpu.txn.chaos` — the seeded coordinator-crash
+  nemesis runner behind the CI strict-serializability smoke.
+
+Host symbols resolve lazily so importing the package (e.g. via the
+device lane from inside jit tracing) never pulls host modules.
+"""
+
+from __future__ import annotations
+
+import importlib
+
+_LAZY = {
+    "TXN_NONE": "lane", "TXN_PENDING": "lane",
+    "TXN_PREPARED": "lane", "TXN_CONFLICT": "lane",
+    "prepare_vote": "lane",
+    "Txn": "coordinator", "TxnCoordinator": "coordinator",
+    "attach_coordinator": "coordinator",
+    "TxnHandle": "api", "transact": "api",
+    "MERGE_FNS": "merge", "is_mergeable": "merge",
+    "mergeable_plan": "merge",
+    "TxnNemesisRunner": "chaos", "run_txn_chaos": "chaos",
+}
+
+__all__ = sorted(_LAZY)
+
+
+def __getattr__(name: str):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}")
+    return getattr(importlib.import_module(f"{__name__}.{mod}"), name)

@@ -1,0 +1,531 @@
+"""Host 2PC coordinator over the in-dispatch commit lane.
+
+The classic coordinator pays a network round-trip per 2PC phase. Here
+every group advances in ONE compiled dispatch, so the phases collapse
+onto the dispatch cadence:
+
+* **prepare** — one PREPARE record per staged write is submitted to
+  each participant group's leader (stamped ``(conn, req)``, the
+  session exactly-once rule). The dispatch that replicates them also
+  evaluates each group's armed prepare watch (``txn/lane.py``) and
+  reports the stacked ``[G, R]`` vote matrix in the SAME readback.
+* **decide** — a PREPARED vote from any replica is definitive (the
+  vote rule requires the watched index be COMMITTED under the watched
+  term, i.e. majority-replicated); a CONFLICT vote is a definitive
+  overwrite-under-failover. All groups prepared ⟹ COMMIT records are
+  submitted; the next dispatch replicates them. Hence a cross-group
+  commit costs ~2 protocol dispatches end to end.
+* **abort** — deterministic, host-decided: step-domain timeout, lock
+  conflict at admission, or participant-leader deposition (observed
+  from the step outputs — the same signal the drivers' failover hooks
+  key on). ABORT records release the groups' staged buffers; until a
+  decision record commits, NOTHING touches any table
+  (``models/replicated_kvs.py`` stages per tid), so aborted
+  transactions leave no partial writes by construction.
+
+Mergeable-only transactions (``txn/merge.py``) skip all of the above:
+their writes commit as independent per-group commands.
+
+Concurrency: participant locks are keyed ``(group, key)`` — a
+conflicting admission aborts immediately (no waiting ⟹ no deadlock).
+The commit lane arms ONE watch per group, so 2PC transactions admit
+serially (queued FIFO); mergeable transactions never queue.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from rdma_paxos_tpu.models.kvs import OP_PUT, OP_RM, encode_cmd
+from rdma_paxos_tpu.txn import merge as _merge
+from rdma_paxos_tpu.txn import records as _records
+from rdma_paxos_tpu.txn.lane import TXN_CONFLICT, TXN_PREPARED
+
+# txn states
+PREPARING = "preparing"      # prepare records out, votes pending
+COMMITTING = "committing"    # commit records out, awaiting commit
+ABORTING = "aborting"        # abort records out, awaiting commit
+COMMITTED = "committed"      # terminal
+ABORTED = "aborted"          # terminal
+MERGING = "merging"          # fast path: merge commands out
+
+
+class Txn:
+    """One transaction's host bookkeeping (coordinator-internal; the
+    client-facing view is :class:`rdma_paxos_tpu.txn.api.TxnHandle`)."""
+
+    def __init__(self, tid: int, writes_by_group: Dict[int, list],
+                 read_keys: Sequence[bytes], deadline: int,
+                 fast: bool):
+        self.tid = tid
+        self.writes_by_group = writes_by_group
+        self.read_keys = list(read_keys)
+        self.deadline = deadline
+        self.fast = fast
+        self.state = MERGING if fast else PREPARING
+        self.reason: Optional[str] = None
+        # per-group: prepares appended so far / (index, term) of the
+        # LAST appended prepare (the group's watch target)
+        self.prep_appended: Dict[int, int] = {}
+        self.watch: Dict[int, Tuple[int, int]] = {}
+        # groups whose watch was armed THIS finish (note_appends runs
+        # in the stamp loop, observe at the tail — same result dict):
+        # eligible for same-finish host resolution
+        self.watch_fresh: Dict[int, bool] = {}
+        self.prepared: set = set()
+        # decision/merge records: (g, req) -> absolute index once
+        # appended (-1 = submitted, not yet appended)
+        self.record_index: Dict[Tuple[int, int], int] = {}
+        # term the record was appended under — a placement is only
+        # proof of commit while the group's term is unchanged
+        self.record_term: Dict[Tuple[int, int], int] = {}
+        self.record_payload: Dict[Tuple[int, int], bytes] = {}
+        # (g, req) -> step of the last (re)submission: decided records
+        # are retried with patience until appended (dedup keeps the
+        # retries exactly-once), surviving leader failover
+        self.record_retry: Dict[Tuple[int, int], int] = {}
+        self.reads: Dict[bytes, Optional[bytes]] = {}
+
+    @property
+    def groups(self) -> Sequence[int]:
+        return sorted(self.writes_by_group)
+
+    @property
+    def done(self) -> bool:
+        return self.state in (COMMITTED, ABORTED)
+
+    @property
+    def committed(self) -> bool:
+        return self.state == COMMITTED
+
+    def participant_mask(self) -> int:
+        mask = 0
+        for g in self.writes_by_group:
+            mask |= 1 << g
+        return mask
+
+
+class TxnCoordinator:
+    """Attached to a :class:`~rdma_paxos_tpu.shard.kvs.ShardedKVS`
+    (``attach_coordinator``): drives begin/prepare/commit/abort off the
+    cluster's finish() tail — ``note_appends`` learns each record's
+    ``(term, index)`` from the stamp loop, ``observe`` reads the vote
+    matrix, advances timeouts, and detects participant deposition."""
+
+    def __init__(self, kvs, *, client_id: int = 1 << 20,
+                 timeout_steps: int = 64):
+        self.kvs = kvs
+        self.cluster = kvs.shard
+        self.G = self.cluster.G
+        if not getattr(self.cluster, "_txn", False):
+            raise ValueError(
+                "attach_coordinator requires a txn=True cluster "
+                "(the commit lane rides the txn= step variant)")
+        self.client_id = client_id
+        self.timeout_steps = int(timeout_steps)
+        self.committed_total = 0
+        self.aborted_total: Dict[str, int] = collections.Counter()
+        # ---- coordinator-lock discipline (runtime_guard-checked) ----
+        # participant locks: (group, key) -> owning tid
+        # guarded-by: _lock [writes]
+        self._locks: Dict[Tuple[int, bytes], int] = {}
+        # live transactions by tid  # guarded-by: _lock [writes]
+        self._txns: Dict[int, Txn] = {}
+        # (group, req) -> tid for in-flight stamped records
+        # guarded-by: _lock [writes]
+        self._outstanding: Dict[Tuple[int, int], int] = {}
+        # FIFO of admitted-but-waiting 2PC txns (one armed watch per
+        # group ⟹ serial 2PC)  # guarded-by: _lock [writes]
+        self._queue: collections.deque = collections.deque()
+        # the 2PC txn currently owning the commit lane (or None)
+        # guarded-by: _lock [writes]
+        self._active_2pc: Optional[int] = None
+        # per-group stamped-request counter  # guarded-by: _lock [writes]
+        self._req = [0] * self.G
+        # per-group term each leader was last seen under (deposition
+        # detection)  # guarded-by: _lock [writes]
+        self._seen_term = [0] * self.G
+        self._next_tid = 1                  # guarded-by: _lock [writes]
+        self._lock = threading.RLock()
+        from rdma_paxos_tpu.analysis import runtime_guard
+        runtime_guard.maybe_guard(self, "_lock", __file__)
+
+    # ---------------- admission ----------------
+
+    def begin(self, writes: Sequence[Tuple[int, bytes, bytes]],
+              reads: Sequence[bytes] = ()) -> Txn:
+        """Admit a transaction: ``writes`` are ``(op, key, val)``
+        triples (op = OP_PUT/OP_RM or a mergeable code), ``reads`` are
+        keys to fetch at the serialization point. Lock conflicts abort
+        immediately (reason ``conflict``). Mergeable-only write sets
+        take the fast path; otherwise the txn joins the 2PC lane."""
+        by_group: Dict[int, list] = {}
+        for op, key, val in writes:
+            by_group.setdefault(self.kvs.group_of(key), []).append(
+                (op, key, val))
+        fast = _merge.mergeable_plan(writes)
+        with self._lock:
+            tid = self._next_tid
+            self._next_tid += 1
+            txn = Txn(tid, by_group, reads,
+                      self.cluster.step_index + self.timeout_steps,
+                      fast)
+            locked: List[Tuple[int, bytes]] = []
+            ok = True
+            for g, ws in by_group.items():
+                for _op, key, _val in ws:
+                    locked.append((g, key))
+            for key in reads:
+                locked.append((self.kvs.group_of(key), key))
+            for lk in locked:
+                if self._locks.get(lk, tid) != tid:
+                    ok = False
+                    break
+                self._locks[lk] = tid
+            if not ok:
+                for lk in locked:
+                    if self._locks.get(lk) == tid:
+                        del self._locks[lk]
+                txn.state = ABORTED
+                txn.reason = "conflict"
+                self._count_abort("conflict")
+                return txn
+            self._txns[tid] = txn
+            if fast:
+                self._submit_merge(txn)
+            elif self._active_2pc is None:
+                self._active_2pc = tid
+                self._submit_prepares(txn)
+            else:
+                self._queue.append(tid)
+        return txn
+
+    # ---------------- record submission ----------------
+
+    def _conn(self, g: int, req: int) -> int:
+        """PER-RECORD conn id: ``(client_id + req)`` pushed through the
+        shared ShardedKVS group-namespacing. The fold's dedup registry
+        is a per-conn HIGH-WATER mark — it assumes FIFO per conn, which
+        client sessions guarantee (one outstanding) but the coordinator
+        cannot: records of concurrent transactions commit out of order
+        across failover, and a retried record behind a later req would
+        be swallowed as a duplicate. One conn per record makes every
+        record its own single-request session: retries dedup exactly
+        (same conn, same req), ordering across records is free.
+        ``client_id`` (1<<20 by default) keeps the range far above real
+        clients; ``req`` is unique per group so the mapping stays
+        injective."""
+        return self.kvs.conn_for(self.client_id + req, g)
+
+    # holds-lock: _lock
+    def _submit_record(self, txn: Txn, g: int, payload: bytes,
+                      track: bool = False) -> int:
+        """Submit one stamped record to ``g``'s current leader; spans
+        ride the same (conn, req) key the stamp loop correlates."""
+        self._req[g] += 1
+        req = self._req[g]
+        self._outstanding[(g, req)] = txn.tid
+        if track:
+            txn.record_index[(g, req)] = -1
+            txn.record_payload[(g, req)] = payload
+            txn.record_retry[(g, req)] = self.cluster.step_index
+        lead = self.cluster.leader_hint(g)
+        lead = lead if lead >= 0 else 0
+        from rdma_paxos_tpu.obs.spans import active_recorder
+        spans = active_recorder(getattr(self.cluster, "obs", None))
+        if spans is not None:
+            spans.begin(self._conn(g, req), req,
+                        self.cluster._span_rep(g, lead),
+                        phase="submit")
+        self.cluster.submit(g, lead, payload, conn=self._conn(g, req),
+                            req_id=req)
+        return req
+
+    # holds-lock: _lock
+    def _submit_prepares(self, txn: Txn) -> None:
+        for g in txn.groups:
+            txn.prep_appended[g] = 0
+            for op, key, val in txn.writes_by_group[g]:
+                self._submit_record(
+                    txn, g, _records.encode_prepare(txn.tid, op, key,
+                                                    val))
+            self._seen_term[g] = 0      # set at first prepare append
+
+    # holds-lock: _lock
+    def _submit_merge(self, txn: Txn) -> None:
+        for g in txn.groups:
+            for op, key, val in txn.writes_by_group[g]:
+                payload = encode_cmd(op, key, val).tobytes()
+                req = self._submit_record(txn, g, payload, track=True)
+
+    # holds-lock: _lock
+    def _submit_decision(self, txn: Txn, commit: bool) -> None:
+        mask = txn.participant_mask()
+        reason = {"conflict": _records.ABORT_CONFLICT,
+                  "timeout": _records.ABORT_TIMEOUT,
+                  "failover": _records.ABORT_FAILOVER}.get(
+                      txn.reason or "", 0)
+        for g in txn.groups:
+            payload = (_records.encode_commit(txn.tid, mask) if commit
+                       else _records.encode_abort(txn.tid, reason))
+            self._submit_record(txn, g, payload, track=True)
+            self.cluster.clear_txn_watch(g)
+
+    # ---------------- cluster hooks ----------------
+
+    def note_appends(self, g: int, r: int, take: Sequence[tuple],
+                     term: int, end_abs: int) -> None:
+        """Stamp-loop hook (cluster.finish, under the host lock): the
+        accepted prefix ``take`` landed at absolute indices
+        ``[end_abs - len(take), end_abs)`` on ``g``'s leader ``r`` —
+        match the coordinator's stamped records to learn each one's
+        ``(term, index)`` and arm the group watch when the last
+        prepare of a group is placed."""
+        with self._lock:
+            if not self._outstanding:
+                return
+            base = end_abs - len(take)
+            for i, (_et, c, req, _p) in enumerate(take):
+                if c != self._conn(g, req):
+                    continue
+                tid = self._outstanding.get((g, req))
+                if tid is None:
+                    continue
+                txn = self._txns.get(tid)
+                if txn is None:
+                    continue
+                index = base + i
+                if (g, req) in txn.record_index:
+                    # decision/merge record placed: completion is its
+                    # index entering the group's commit frontier
+                    # while the append term still rules
+                    txn.record_index[(g, req)] = index
+                    txn.record_term[(g, req)] = term
+                    del self._outstanding[(g, req)]
+                elif txn.state == PREPARING:
+                    txn.prep_appended[g] += 1
+                    self._seen_term[g] = max(self._seen_term[g], term)
+                    del self._outstanding[(g, req)]
+                    if (txn.prep_appended[g]
+                            == len(txn.writes_by_group[g])):
+                        # last prepare of g placed: watch it — votes
+                        # ride the NEXT dispatch, but this dispatch's
+                        # own readback may already prove the commit
+                        # (observe's same-finish resolution)
+                        txn.watch[g] = (index, term)
+                        txn.watch_fresh[g] = True
+                        self.cluster.set_txn_watch(g, index, term)
+
+    def observe(self, cluster, res) -> None:
+        """finish()-tail hook: consume the vote matrix, detect
+        participant deposition, advance step-domain timeouts, and
+        complete decided transactions whose records committed."""
+        with self._lock:
+            if not self._txns:
+                return
+            commit_abs = self._commit_abs(res)
+            votes = res.get("txn_vote")
+            for txn in list(self._txns.values()):
+                if txn.state == PREPARING:
+                    self._observe_preparing(txn, res, votes,
+                                            commit_abs)
+                if txn.state in (COMMITTING, ABORTING, MERGING):
+                    self._observe_decided(txn, res, commit_abs)
+                if (not txn.done and txn.state != COMMITTING
+                        and cluster.step_index > txn.deadline):
+                    # commit decisions are durable once made — only
+                    # undecided (or merging/aborting) txns time out,
+                    # and a merge past deadline keeps retrying via
+                    # resubmission (its writes are already decided)
+                    if txn.state in (PREPARING,):
+                        self._abort(txn, "timeout")
+
+    # holds-lock: _lock
+    def _commit_abs(self, res) -> List[int]:
+        """Per-group ABSOLUTE commit frontier (max over replicas —
+        commit indices are quorum facts, any replica's is valid)."""
+        import numpy as np
+        commit = np.asarray(res["commit"])
+        reb = self.cluster.rebased_total
+        return [int(commit[g].max()) + int(reb[g])
+                for g in range(self.G)]
+
+    # holds-lock: _lock
+    def _observe_preparing(self, txn: Txn, res, votes,
+                           commit_abs) -> None:
+        # deposition: a participant's leader advanced past the term
+        # its prepares were appended under — the prepare may be
+        # overwritten; abort deterministically (the vote lane's
+        # CONFLICT is the committed-overwrite backstop)
+        import numpy as np
+        term_now = np.asarray(res["term"])
+        for g in txn.prep_appended:
+            seen = self._seen_term[g]
+            if seen and int(term_now[g].max()) > seen:
+                self._abort(txn, "failover")
+                return
+        for g, (idx, wterm) in list(txn.watch.items()):
+            if g in txn.prepared:
+                continue
+            if txn.watch_fresh.pop(g, False):
+                # same-finish resolution: the prepare landed in THIS
+                # dispatch under ``wterm``; if this finish's commit
+                # frontier already covers it and the term is
+                # unchanged, nothing can have overwritten it — the
+                # common case resolves without waiting a dispatch for
+                # the vote lane (⟹ cross-group commit ≈ 2 dispatches)
+                if (idx < commit_abs[g]
+                        and int(term_now[g].max()) == wterm):
+                    txn.prepared.add(g)
+                    self.cluster.clear_txn_watch(g)
+                    continue
+            if votes is None:
+                continue
+            row = votes[g]
+            if (row == TXN_CONFLICT).any():
+                self._abort(txn, "conflict")
+                return
+            if (row == TXN_PREPARED).any():
+                txn.prepared.add(g)
+                self.cluster.clear_txn_watch(g)
+        if txn.prepared == set(txn.groups):
+            # serialization point: all participants hold the staged
+            # writes durably — fetch the read set under the locks,
+            # then decide commit
+            for key in txn.read_keys:
+                txn.reads[key] = self.kvs.get(key)
+            txn.state = COMMITTING
+            self._submit_decision(txn, commit=True)
+
+    # retry patience (steps) before a decided record not yet appended
+    # is resubmitted — covers a deposed/mis-hinted leader that dropped
+    # the submission (dedup keeps every retry exactly-once)
+    RETRY_STEPS = 4
+
+    # holds-lock: _lock
+    def _observe_decided(self, txn: Txn, res, commit_abs) -> None:
+        import numpy as np
+        term_now = np.asarray(res["term"])
+        for (g, req), idx in list(txn.record_index.items()):
+            if idx >= 0:
+                wterm = txn.record_term.get((g, req), 0)
+                if idx < commit_abs[g] and int(term_now[g].max()) == wterm:
+                    del txn.record_index[(g, req)]
+                    txn.record_term.pop((g, req), None)
+                    txn.record_payload.pop((g, req), None)
+                    txn.record_retry.pop((g, req), None)
+                elif int(term_now[g].max()) > wterm:
+                    # the append may sit on a deposed leader's
+                    # overwritten suffix — a later commit frontier
+                    # past its index proves nothing. Forget the
+                    # placement and retry under the SAME stamp: if it
+                    # DID commit, dedup makes the retry a no-op.
+                    txn.record_index[(g, req)] = -1
+                    txn.record_retry[(g, req)] = self.cluster.step_index
+            elif idx < 0:
+                lead = self.cluster.leader_hint(g)
+                if (lead >= 0 and self.cluster.step_index
+                        > txn.record_retry[(g, req)] + self.RETRY_STEPS):
+                    payload = txn.record_payload[(g, req)]
+                    self._outstanding[(g, req)] = txn.tid
+                    txn.record_retry[(g, req)] = self.cluster.step_index
+                    self.cluster.submit(g, lead, payload,
+                                        conn=self._conn(g, req),
+                                        req_id=req)
+        if not txn.record_index:
+            self._finalize(txn)
+
+    # ---------------- decisions ----------------
+
+    # holds-lock: _lock
+    def _abort(self, txn: Txn, reason: str) -> None:
+        txn.reason = reason
+        txn.state = ABORTING
+        self._count_abort(reason)
+        # drop any still-outstanding prepare stamps
+        for key, tid in list(self._outstanding.items()):
+            if tid == txn.tid and key not in txn.record_index:
+                del self._outstanding[key]
+        for g in list(txn.watch):
+            self.cluster.clear_txn_watch(g)
+        txn.watch.clear()
+        if txn.prep_appended:
+            self._submit_decision(txn, commit=False)
+
+    # holds-lock: _lock
+    def _finalize(self, txn: Txn) -> None:
+        if txn.state == COMMITTING:
+            txn.state = COMMITTED
+            self.committed_total += 1
+            obs = getattr(self.cluster, "obs", None)
+            if obs is not None:
+                obs.metrics.inc("txn_committed_total")
+        elif txn.state == ABORTING:
+            txn.state = ABORTED
+        elif txn.state == MERGING:
+            # fast path: every merge command committed — convergent by
+            # commutativity, atomic in the no-torn-intermediate sense
+            txn.state = COMMITTED
+            self.committed_total += 1
+            obs = getattr(self.cluster, "obs", None)
+            if obs is not None:
+                obs.metrics.inc("txn_committed_total")
+        self._release(txn)
+
+    # holds-lock: _lock
+    def _count_abort(self, reason: str) -> None:
+        self.aborted_total[reason] += 1
+        obs = getattr(self.cluster, "obs", None)
+        if obs is not None:
+            obs.metrics.inc("txn_aborted_total", reason=reason)
+
+    # holds-lock: _lock
+    def _release(self, txn: Txn) -> None:
+        for lk, tid in list(self._locks.items()):
+            if tid == txn.tid:
+                del self._locks[lk]
+        self._txns.pop(txn.tid, None)
+        for key, tid in list(self._outstanding.items()):
+            if tid == txn.tid:
+                del self._outstanding[key]
+        if self._active_2pc == txn.tid:
+            self._active_2pc = None
+            while self._queue:
+                nxt = self._txns.get(self._queue.popleft())
+                if nxt is not None and not nxt.done:
+                    self._active_2pc = nxt.tid
+                    self._submit_prepares(nxt)
+                    break
+
+    # ---------------- driver surface ----------------
+
+    def wants_serial(self) -> bool:
+        """True while any transaction is in flight: the commit lane
+        (votes, decision records) rides SERIAL dispatches only, so the
+        drivers hold bursts/pipelining — the same give-way rule
+        elections and repair already follow."""
+        with self._lock:
+            return bool(self._txns)
+
+    def health(self) -> dict:
+        with self._lock:
+            return dict(
+                active=len(self._txns),
+                queued=len(self._queue),
+                locks=len(self._locks),
+                committed_total=self.committed_total,
+                aborted_total=dict(self.aborted_total))
+
+
+def attach_coordinator(kvs, *, client_id: int = 1 << 20,
+                       timeout_steps: int = 64) -> TxnCoordinator:
+    """Build a coordinator over ``kvs`` (a ShardedKVS on a txn=True
+    cluster) and attach it at ``cluster.txn`` — the finish() tail and
+    stamp loop start feeding it, and the drivers' give-way gates see
+    it through the same attach point."""
+    coord = TxnCoordinator(kvs, client_id=client_id,
+                           timeout_steps=timeout_steps)
+    kvs.shard.txn = coord
+    return coord

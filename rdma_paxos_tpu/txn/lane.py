@@ -1,0 +1,56 @@
+"""Device-side commit lane of the cross-group transaction subsystem.
+
+The host 2PC coordinator (``txn/coordinator.py``) appends one PREPARE
+record per participant group and then needs each group's verdict:
+*did my prepare become durable under the term I appended it in, or did
+a leader change overwrite it?* Because all G groups advance in ONE
+compiled dispatch (``group_step`` / the spmd mesh), that verdict is
+computed *inside* the dispatch — each replica evaluates a per-group
+watch ``(index, term)`` against its own post-absorb log and reports a
+small vote scalar. The coordinator reads the stacked ``[G, R]`` vote
+matrix from the very dispatch that replicated the prepares, so a
+cross-group commit resolves in ~2 protocol steps instead of a host
+round-trip per 2PC phase.
+
+This module is device-pure by construction (jnp only — it is listed in
+the static-analysis ``DEVICE_MODULES`` set) and is the ONLY txn module
+``consensus/step.py`` may import: the host state machine, locks, and
+API live behind the lazy ``txn/__init__`` and never reach jitted code.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+# Prepare-vote values, reported per (group, replica) when the ``txn=``
+# step variant is compiled. The coordinator treats CONFLICT as
+# dominant, then PREPARED, else PENDING (NONE rows carry no watch).
+TXN_NONE = 0       # no watch armed for this group
+TXN_PENDING = 1    # prepare appended but not yet committed
+TXN_PREPARED = 2   # prepare durable: committed under the watched term
+TXN_CONFLICT = 3   # index committed under a DIFFERENT term (the
+                   # prepare was overwritten by a failover leader)
+
+
+def prepare_vote(*, watch: jnp.ndarray, watch_term: jnp.ndarray,
+                 head: jnp.ndarray, commit: jnp.ndarray,
+                 entry_term: jnp.ndarray,
+                 entry_gidx: jnp.ndarray) -> jnp.ndarray:
+    """One replica's prepare vote for its group's armed watch.
+
+    ``watch`` is the prepare entry's log offset (-1 = no watch armed);
+    ``entry_term``/``entry_gidx`` are the meta columns of the slot the
+    watch maps to in THIS replica's post-absorb log. A watch below the
+    prune head votes PREPARED: pruning follows the host apply cursor,
+    so a pruned index was committed and replayed — and the state-
+    machine fold's per-tid record check is the backstop for the
+    (coordinator-abort-covered) case where a failover overwrote the
+    index before it committed.
+    """
+    vote = jnp.where(
+        watch < head, TXN_PREPARED,
+        jnp.where(
+            (entry_gidx == watch) & (entry_term == watch_term)
+            & (watch < commit), TXN_PREPARED,
+            jnp.where(watch < commit, TXN_CONFLICT, TXN_PENDING)))
+    return jnp.where(watch < 0, TXN_NONE, vote).astype(jnp.int32)

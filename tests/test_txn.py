@@ -1,0 +1,512 @@
+"""txn/ — cross-group atomic transactions: acceptance properties.
+
+* the ``txn=`` flag is cache-key guarded exactly like ``audit=`` /
+  ``telemetry=``: txn=False clusters add NOTHING to ``STEP_CACHE``
+  (programs and keys bit-identical to the pre-txn world) and their
+  step outputs are bit-identical to a txn=True cluster's on the same
+  recorded workload;
+* the device vote lane (``txn/lane.py``) answers the armed prepare
+  watch from log facts only: committed-under-watched-term ⟹ PREPARED,
+  overwritten ⟹ CONFLICT, not-yet-committed ⟹ PENDING — on
+  ``SimCluster``, the vmap ``ShardedCluster``, AND the spmd mesh
+  engine (mesh ≡ vmap vote parity is asserted bit-for-bit);
+* the 2PC commit lane resolves a cross-group commit in ~2 protocol
+  dispatches (counted), staged writes apply only at COMMIT (aborts
+  leave no partial writes), lock conflicts abort immediately, and an
+  unreachable participant aborts by step-domain timeout;
+* the mergeable fast path (INCR/SADD/MAX) commits without prepare and
+  converges through the same fold;
+* the strict-serializability checker (``chaos/serialize.py``) accepts
+  clean histories and rejects partial commits, commit+abort, and
+  cross-group commit-order cycles;
+* the seeded txn nemesis (coordinator-leader crash mid-prepare) is
+  green and deterministic;
+* the observability surfaces ride along: abort-rate alert rule,
+  health/console columns, counters, and the graftlint jit-purity scan
+  covering ``txn/lane.py``.
+"""
+
+import numpy as np
+import pytest
+
+from rdma_paxos_tpu.config import LogConfig
+from rdma_paxos_tpu.models.kvs import CMD_W, OP_INCR, OP_MAX, OP_SADD
+from rdma_paxos_tpu.models.replicated_kvs import ReplicatedKVS
+from rdma_paxos_tpu.runtime.sim import STEP_CACHE, SimCluster
+from rdma_paxos_tpu.shard import ShardedCluster
+from rdma_paxos_tpu.shard.kvs import ShardedKVS
+from rdma_paxos_tpu.txn import (
+    TXN_CONFLICT, TXN_NONE, TXN_PENDING, TXN_PREPARED,
+    attach_coordinator)
+from rdma_paxos_tpu.txn.chaos import keys_for_groups
+from rdma_paxos_tpu.txn.merge import decode_merge_val, encode_merge_val
+from rdma_paxos_tpu.txn.records import (
+    TXN_ABORT, TXN_CMD_W, TXN_COMMIT, TXN_PREPARE, decode_record,
+    encode_abort, encode_commit, encode_prepare)
+
+# a geometry no other test uses: the cache-key guard below reasons
+# about which keys THIS test file's clusters add to the shared cache
+CFG = LogConfig(n_slots=128, slot_bytes=128, window_slots=16,
+                batch_slots=8)
+
+
+def _commit_one(c: SimCluster, payload: bytes) -> int:
+    """Submit at the leader and step until committed; -> absolute
+    index of the entry."""
+    c.submit(0, payload)
+    idx = int(c.last["end"][0])
+    for _ in range(4):
+        c.step()
+        if int(c.last["commit"][0]) > idx:
+            break
+    assert int(c.last["commit"][0]) > idx
+    return idx + int(c.rebased_total)
+
+
+# ---------------------------------------------------------------------------
+# device vote lane
+# ---------------------------------------------------------------------------
+
+def test_vote_lane_sim():
+    c = SimCluster(CFG, 3, txn=True)
+    c.run_until_elected(0)
+    term = int(c.last["term"][0])
+    idx = _commit_one(c, b"prep")
+    # no watch armed: every replica reports NONE
+    c.step()
+    assert (np.asarray(c.last["txn_vote"]) == TXN_NONE).all()
+    # committed under the watched term: PREPARED (the leader holds
+    # the entry; every in-sync replica agrees)
+    c.set_txn_watch(idx, term)
+    c.step()
+    votes = np.asarray(c.last["txn_vote"])
+    assert votes[0] == TXN_PREPARED
+    assert set(votes.tolist()) <= {TXN_PREPARED}
+    # wrong watched term on a committed index: definitive CONFLICT
+    c.set_txn_watch(idx, term + 5)
+    c.step()
+    assert np.asarray(c.last["txn_vote"])[0] == TXN_CONFLICT
+    # a future index: PENDING (no fact yet, keep waiting)
+    c.set_txn_watch(idx + 10, term)
+    c.step()
+    assert np.asarray(c.last["txn_vote"])[0] == TXN_PENDING
+    c.clear_txn_watch()
+    c.step()
+    assert (np.asarray(c.last["txn_vote"]) == TXN_NONE).all()
+
+
+def _vote_workload(c: ShardedCluster) -> list:
+    """Recorded per-group watch workload; -> the txn_vote snapshots."""
+    out = []
+    for g in range(2):
+        c.run_until_elected(g, g)
+    lead = [c.leader(0), c.leader(1)]
+    for g in (0, 1):
+        c.submit(g, lead[g], b"w%d" % g)
+    for _ in range(3):
+        c.step()
+    term0 = int(c.last["term"][0].max())
+    idx0 = int(c.last["commit"][0].max()) - 1
+    c.set_txn_watch(0, idx0, term0)
+    c.step()
+    out.append(np.asarray(c.last["txn_vote"]).copy())
+    c.set_txn_watch(0, idx0, term0 + 3)     # wrong term: CONFLICT
+    c.set_txn_watch(1, 10 ** 6, 1)          # far future: PENDING
+    c.step()
+    out.append(np.asarray(c.last["txn_vote"]).copy())
+    c.clear_txn_watch()
+    c.step()
+    out.append(np.asarray(c.last["txn_vote"]).copy())
+    return out
+
+
+def test_vote_lane_sharded_per_group():
+    c = ShardedCluster(CFG, 3, 2, txn=True)
+    v1, v2, v3 = _vote_workload(c)
+    assert v1[0].max() == TXN_PREPARED and (v1[1] == TXN_NONE).all()
+    assert v2[0].max() == TXN_CONFLICT
+    assert (v2[1] == TXN_PENDING).all()
+    assert (v3 == TXN_NONE).all()
+
+
+def test_vote_lane_mesh_bit_identical_to_vmap():
+    """mesh ≡ vmap: the spmd engine threads the watch inputs and
+    reports the identical stacked vote matrix."""
+    a = ShardedCluster(CFG, 3, 2, txn=True)
+    b = ShardedCluster(CFG, 3, 2, txn=True, mesh=(2, 3))
+    va, vb = _vote_workload(a), _vote_workload(b)
+    for x, y in zip(va, vb):
+        assert np.array_equal(x, y)
+    for k in ("term", "commit", "end", "apply", "role"):
+        assert np.array_equal(np.asarray(a.last[k]),
+                              np.asarray(b.last[k])), k
+
+
+# ---------------------------------------------------------------------------
+# txn=False bit-identity (the audit=/telemetry= discipline)
+# ---------------------------------------------------------------------------
+
+def test_txn_off_cache_keys_bit_identical():
+    # fresh geometry: no other test (or earlier test here) has
+    # populated the cache for it, so the added-key sets are exact
+    cfg = LogConfig(n_slots=32, slot_bytes=128, window_slots=8,
+                    batch_slots=4)
+    plain = SimCluster(cfg, 3)
+    plain.run_until_elected(0)
+    plain.submit(0, b"x")
+    plain.step()
+    keys_before = set(STEP_CACHE)
+
+    on = SimCluster(cfg, 3, txn=True)
+    on.run_until_elected(0)
+    on.submit(0, b"y")
+    on.step()
+    added = set(STEP_CACHE) - keys_before
+    assert added and all("txn" in str(k) for k in added), (
+        "txn variants must carry the 'txn' cache-key marker")
+    assert keys_before <= set(STEP_CACHE)
+
+    # a fresh txn=False cluster adds NOTHING: default keys (and
+    # therefore default programs) are bit-identical to the seed
+    after_txn = set(STEP_CACHE)
+    plain2 = SimCluster(cfg, 3)
+    plain2.run_until_elected(0)
+    plain2.submit(0, b"z")
+    plain2.step()
+    assert set(STEP_CACHE) == after_txn
+
+
+def test_txn_off_outputs_bit_identical():
+    a = SimCluster(CFG, 3)
+    b = SimCluster(CFG, 3, txn=True)
+    for c in (a, b):
+        c.run_until_elected(0)
+        for t in range(4):
+            c.submit(0, b"t%d" % t)
+            c.step()
+    for k in ("term", "commit", "end", "apply", "head", "role"):
+        assert np.array_equal(np.asarray(a.last[k]),
+                              np.asarray(b.last[k])), k
+    assert "txn_vote" not in a.last and "txn_vote" in b.last
+
+
+# ---------------------------------------------------------------------------
+# records + mergeable device ops
+# ---------------------------------------------------------------------------
+
+def test_txn_records_roundtrip_and_width():
+    assert TXN_CMD_W == 3 + CMD_W
+    p = encode_prepare(7, 1, b"k", b"v")
+    assert len(p) == TXN_CMD_W * 4 and len(p) != CMD_W * 4
+    op, tid, arg, cmd = decode_record(p)
+    assert (op, tid) == (TXN_PREPARE, 7) and len(cmd) == CMD_W
+    op, tid, arg, _ = decode_record(encode_commit(9, 0b101))
+    assert (op, tid, arg) == (TXN_COMMIT, 9, 0b101)
+    op, tid, arg, _ = decode_record(encode_abort(3, 2))
+    assert (op, tid, arg) == (TXN_ABORT, 3, 2)
+
+
+def test_mergeable_ops_fold_and_tombstone_base():
+    c = SimCluster(CFG, 3)
+    c.run_until_elected(0)
+    kv = ReplicatedKVS(c, cap=64)
+
+    def pump(n=2):
+        for _ in range(n):
+            c.step()
+
+    kv.merge(0, OP_INCR, b"ctr", encode_merge_val(OP_INCR, 5))
+    pump()
+    kv.merge(0, OP_INCR, b"ctr", encode_merge_val(OP_INCR, -2))
+    pump()
+    assert decode_merge_val(OP_INCR, kv.get(0, b"ctr")) == 3
+    kv.merge(0, OP_MAX, b"hi", encode_merge_val(OP_MAX, 10))
+    pump()
+    kv.merge(0, OP_MAX, b"hi", encode_merge_val(OP_MAX, 4))
+    pump()
+    assert decode_merge_val(OP_MAX, kv.get(0, b"hi")) == 10
+    for bit in (3, 3, 77):
+        kv.merge(0, OP_SADD, b"set", encode_merge_val(OP_SADD, bit))
+        pump()
+    assert decode_merge_val(OP_SADD, kv.get(0, b"set")) == 2
+    # a removed key's slot may hold a stale value — merges must read
+    # their base through the live match only (start from zero)
+    kv.put(0, b"ctr2", encode_merge_val(OP_INCR, 99))
+    pump()
+    kv.remove(0, b"ctr2")
+    pump()
+    kv.merge(0, OP_INCR, b"ctr2", encode_merge_val(OP_INCR, 1))
+    pump()
+    assert decode_merge_val(OP_INCR, kv.get(0, b"ctr2")) == 1
+
+
+# ---------------------------------------------------------------------------
+# coordinator: 2PC commit lane + fast path
+# ---------------------------------------------------------------------------
+
+def _txn_cluster(G=2, timeout_steps=64):
+    shard = ShardedCluster(CFG, 3, G, txn=True)
+    from rdma_paxos_tpu.obs import Observability
+    shard.obs = Observability()
+    kv = ShardedKVS(shard, cap=256)
+    coord = attach_coordinator(kv, timeout_steps=timeout_steps)
+    shard.place_leaders()
+    keys = keys_for_groups(kv.router, 4)
+    return shard, kv, coord, keys
+
+
+def test_twopc_commit_two_dispatches_and_visibility():
+    shard, kv, coord, keys = _txn_cluster()
+    # warm the txn-lane program so the probe counts steady-state
+    h = kv.transact([("put", keys[0][3], b"w"), ("put", keys[1][3],
+                                                 b"w")])
+    for _ in range(6):
+        if h.done:
+            break
+        shard.step()
+    assert h.committed
+
+    d0 = shard.dispatches
+    h = kv.transact([("put", keys[0][0], b"va"),
+                     ("put", keys[1][0], b"vb")])
+    steps = 0
+    while not h.done and steps < 8:
+        shard.step()
+        steps += 1
+    assert h.committed
+    assert shard.dispatches - d0 == 2, (
+        "cross-group commit must resolve in ~2 protocol dispatches")
+    assert kv.get(keys[0][0]) == b"va"
+    assert kv.get(keys[1][0]) == b"vb"
+    assert coord.health()["committed_total"] == 2
+    assert coord.health()["locks"] == 0
+
+
+def test_twopc_read_set_at_serialization_point():
+    shard, kv, coord, keys = _txn_cluster()
+    h = kv.transact([("put", keys[0][1], b"base")])
+    while not h.done:
+        shard.step()
+    h = kv.transact([("put", keys[1][1], b"x")],
+                    reads=[keys[0][1]])
+    while not h.done:
+        shard.step()
+    assert h.committed and h.reads[keys[0][1]] == b"base"
+
+
+def test_conflict_aborts_immediately_no_partial_writes():
+    shard, kv, coord, keys = _txn_cluster()
+    a = kv.transact([("put", keys[0][0], b"A0"),
+                     ("put", keys[1][0], b"A1")])
+    # same key in the write set while A holds the lock: immediate
+    # deterministic abort, nothing submitted anywhere
+    b = kv.transact([("put", keys[0][0], b"B0"),
+                     ("put", keys[1][2], b"B1")])
+    assert b.done and not b.committed and b.abort_reason == "conflict"
+    while not a.done:
+        shard.step()
+    assert a.committed and kv.get(keys[0][0]) == b"A0"
+    assert kv.get(keys[1][2]) is None       # B left no partial write
+    m = shard.obs.metrics.snapshot()["counters"]
+    assert m.get("txn_committed_total") == 1
+    assert m.get("txn_aborted_total{reason=conflict}") == 1
+
+
+def test_unreachable_participant_times_out_and_aborts():
+    shard, kv, coord, keys = _txn_cluster(timeout_steps=4)
+    dead = shard.leader(0)
+    shard.partition(0, [[dead], [r for r in range(3) if r != dead]])
+    h = kv.transact([("put", keys[0][0], b"lost"),
+                     ("put", keys[1][0], b"staged")])
+    for _ in range(8):
+        shard.step()
+    # the decision is host-made at the step-domain deadline; the ABORT
+    # record to the dead group waits for a live leader to land on
+    assert h.state in ("aborting", "aborted")
+    assert not h.committed and h.abort_reason == "timeout"
+    shard.heal(0)
+    cand = next(r for r in range(3) if r != dead)
+    shard.step(timeouts={0: [cand]})
+    for _ in range(16):
+        if h.done:
+            break
+        shard.step()
+    assert h.done and not h.committed
+    # the staged write on the healthy group was dropped at ABORT
+    assert kv.get(keys[1][0]) is None
+    assert kv.get(keys[0][0]) is None
+
+
+def test_merge_fast_path_skips_prepare():
+    shard, kv, coord, keys = _txn_cluster()
+    d0 = shard.dispatches
+    h = kv.transact([("incr", keys[0][0], 5), ("incr", keys[1][0],
+                                               11)])
+    steps = 0
+    while not h.done and steps < 8:
+        shard.step()
+        steps += 1
+    assert h.committed
+    assert shard.dispatches - d0 <= 2
+    h2 = kv.transact([("incr", keys[0][0], 2)])
+    while not h2.done:
+        shard.step()
+    raw = kv.get(keys[0][0])
+    assert decode_merge_val(OP_INCR, raw) == 7
+    assert coord.health()["aborted_total"] == {}
+
+
+def test_attach_requires_txn_flag_and_transact_requires_attach():
+    shard = ShardedCluster(CFG, 3, 2)           # txn=False
+    kv = ShardedKVS(shard, cap=64)
+    with pytest.raises(ValueError):
+        attach_coordinator(kv)
+    with pytest.raises(RuntimeError):
+        kv.transact([("put", b"k", b"v")])
+
+
+def test_txn_under_live_sharded_driver():
+    """e2e: the driver's poll loop serves a transaction — bursts and
+    pipelining give way while the commit lane is live (wants_serial),
+    and health()/counters carry the txn surfaces."""
+    import tempfile
+    import time
+
+    from rdma_paxos_tpu.obs.health import validate_cluster
+    from rdma_paxos_tpu.runtime.sharded_driver import \
+        ShardedClusterDriver
+
+    cfg = LogConfig(n_slots=256, slot_bytes=128, window_slots=32,
+                    batch_slots=16)
+    wd = tempfile.mkdtemp(prefix="txn_drive")
+    d = ShardedClusterDriver(cfg, 3, 2, workdir=wd, txn=True,
+                             pipeline=2)
+    kv = ShardedKVS(d.cluster, cap=256)
+    coord = attach_coordinator(kv, timeout_steps=512)
+    d.run(period=0.002)
+    try:
+        t0 = time.time()
+        while time.time() - t0 < 30:
+            if all(d.cluster.leader_hint(g) >= 0 for g in range(2)):
+                break
+            time.sleep(0.02)
+        assert all(d.cluster.leader_hint(g) >= 0 for g in range(2))
+        keys = keys_for_groups(kv.router, 4)
+        h = kv.transact([("put", keys[0][0], b"live-a"),
+                         ("put", keys[1][0], b"live-b")])
+        t0 = time.time()
+        while not h.done and time.time() - t0 < 30:
+            time.sleep(0.005)
+        assert h.committed, (h.state, h.abort_reason)
+        assert kv.get(keys[0][0]) == b"live-a"
+        assert kv.get(keys[1][0]) == b"live-b"
+        h2 = kv.transact([("incr", keys[0][2], 7),
+                          ("incr", keys[1][2], 3)])
+        t0 = time.time()
+        while not h2.done and time.time() - t0 < 30:
+            time.sleep(0.005)
+        assert h2.committed
+        assert decode_merge_val(OP_INCR, kv.get(keys[0][2])) == 7
+        hd = d.health()
+        assert hd["txn"]["committed_total"] == 2
+        assert hd["txn"]["active"] == 0 and hd["txn"]["locks"] == 0
+        assert validate_cluster(hd) == []
+        m = d.obs.metrics.snapshot()["counters"]
+        assert m.get("txn_committed_total") == 2
+    finally:
+        d.stop()
+
+
+# ---------------------------------------------------------------------------
+# strict-serializability checker
+# ---------------------------------------------------------------------------
+
+def _send(payload, conn=0, req=0):
+    from rdma_paxos_tpu.consensus.log import EntryType
+    return (int(EntryType.SEND), conn, req, payload)
+
+
+def test_serialize_checker_accepts_clean_and_rejects_violations():
+    from rdma_paxos_tpu.chaos.serialize import check_txn_streams
+    p1 = encode_prepare(1, 1, b"a", b"x")
+    p2 = encode_prepare(2, 1, b"b", b"y")
+    c1 = encode_commit(1, 0b11)
+    c2 = encode_commit(2, 0b11)
+    # clean: both groups commit 1 then 2 — witness order [1, 2]
+    v = check_txn_streams([[_send(p1), _send(c1), _send(p2),
+                            _send(c2)],
+                           [_send(p1), _send(c1), _send(p2),
+                            _send(c2)]])
+    assert v["ok"] and v["order"] == [1, 2]
+    # partial commit: tid 1 commits in group 0 only
+    v = check_txn_streams([[_send(p1), _send(c1)], [_send(p1)]])
+    assert not v["ok"]
+    assert any(x["kind"] == "partial_commit" for x in v["violations"])
+    # commit + abort for the same tid
+    v = check_txn_streams([[_send(p1), _send(c1)],
+                           [_send(p1), _send(encode_abort(1, 1)),
+                            _send(encode_commit(1, 0b11))]])
+    assert any(x["kind"] == "commit_and_abort"
+               for x in v["violations"])
+    # cycle: the two groups commit 1/2 in OPPOSITE orders
+    v = check_txn_streams([[_send(p1), _send(p2), _send(c1),
+                            _send(c2)],
+                           [_send(p1), _send(p2), _send(c2),
+                            _send(c1)]])
+    assert not v["ok"]
+    assert any(x["kind"] == "serialization_cycle"
+               for x in v["violations"])
+    # commit with no prepare staged in that group
+    v = check_txn_streams([[_send(c1)], [_send(p1), _send(c1)]])
+    assert any(x["kind"] == "commit_without_prepare"
+               for x in v["violations"])
+
+
+# ---------------------------------------------------------------------------
+# chaos: coordinator-leader crash mid-prepare (the CI smoke's twin)
+# ---------------------------------------------------------------------------
+
+def test_txn_nemesis_green_and_deterministic():
+    import json
+    from rdma_paxos_tpu.txn.chaos import run_txn_chaos
+    v1 = run_txn_chaos(seed=5)
+    assert v1["ok"], v1
+    assert v1["serializability"]["ok"]
+    assert v1["effect_violations"] == []
+    assert v1["txns"]["straddler"]["state"] == "aborted"
+    assert v1["linearizability"]["ok"] is True
+    v2 = run_txn_chaos(seed=5)
+    assert json.dumps(v1, sort_keys=True, default=str) == \
+        json.dumps(v2, sort_keys=True, default=str)
+
+
+# ---------------------------------------------------------------------------
+# observability + lint surfaces
+# ---------------------------------------------------------------------------
+
+def test_abort_rate_alert_rule_in_default_set():
+    from rdma_paxos_tpu.obs.alerts import default_rules
+    rules = {r["name"]: r for r in default_rules()}
+    r = rules["txn_abort_rate"]
+    assert r["kind"] == "counter_rate"
+    assert r["metric"] == "txn_aborted_total"
+    assert r["severity"] == "warn"
+
+
+def test_health_and_console_surface_txn():
+    from rdma_paxos_tpu.obs.console import _txn_state
+    from rdma_paxos_tpu.obs.health import CLUSTER_HEALTH_FIELDS
+    assert "txn" in CLUSTER_HEALTH_FIELDS
+    s = _txn_state(dict(txn=dict(committed_total=3, active=2,
+                                 aborted_total=dict(conflict=1))))
+    assert s == "3c/1a 2live"
+    assert _txn_state(dict()) == "-"
+
+
+def test_jit_safety_scan_covers_txn_lane():
+    """txn/lane.py runs inside the compiled step: the graftlint
+    jit-purity pass must scan it (DEVICE_MODULES) and find nothing."""
+    from rdma_paxos_tpu.analysis import assert_jit_purity
+    from rdma_paxos_tpu.analysis.purity import DEVICE_MODULES
+    assert "rdma_paxos_tpu/txn/lane.py" in DEVICE_MODULES
+    assert_jit_purity()
