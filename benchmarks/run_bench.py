@@ -797,7 +797,7 @@ def measure_txn(cfg=None, *, n_replicas=3, n_groups=3, n_probe=12,
       methodology): ``merge`` drives INCR transactions through the
       coordinator's fast path, ``plain`` drives the identical count
       of stamped single-key puts over the same keys; the fast path
-      skips prepare entirely (one plain command per write), so its
+      skips prepare entirely (one MERGE record per write), so its
       committed throughput must hold ~1x plain (target >=0.9x).
     """
     import random as _random

@@ -16,6 +16,7 @@ the same trade the reference's follower apps offer.
 
 from __future__ import annotations
 
+import collections
 import time
 from typing import List, Optional
 
@@ -28,8 +29,18 @@ from rdma_paxos_tpu.models.kvs import (
     CMD_W, OP_GET, OP_PUT, OP_RM, KVState, apply_cmd, decode_val,
     encode_cmd, make_kvs)
 from rdma_paxos_tpu.txn.records import (
-    TXN_ABORT, TXN_CMD_W, TXN_COMMIT, TXN_PREPARE)
+    TXN_ABORT, TXN_CMD_W, TXN_COMMIT, TXN_MERGE, TXN_PREPARE)
 from rdma_paxos_tpu.runtime.sim import SimCluster
+
+# capacity of the per-replica ring of finished (decided/complete)
+# transaction ids: duplicate txn records (decisions and merges are
+# retried under their ORIGINAL stamp across failover) trail their
+# first committed copy by at most the retry patience plus a couple of
+# confirmation dispatches, all of them SERIAL while the transaction
+# is live (the coordinator's wants_serial gate), so the stream gap
+# between a record and its last duplicate is a few hundred entries —
+# orders of magnitude under this bound
+TXN_DONE_CAP = 65536
 
 
 class ReplicatedKVS:
@@ -68,13 +79,22 @@ class ReplicatedKVS:
         # GETs, retransmits) is recorded as invoke/ok/fail events for
         # the linearizability checker. Host-side bookkeeping only.
         self.history = None
-        # 2PC staging (txn/records.py): per-replica tid -> buffered
-        # kvs-command words, folded DETERMINISTICALLY from the
-        # committed stream like last_req — a PREPARE record stages its
-        # embedded write here, the COMMIT record applies the buffer in
-        # staging order, ABORT drops it. Writes of an aborted (or
-        # never-decided) transaction therefore never reach the table.
+        # txn staging + exactly-once (txn/records.py): per-replica
+        # tid -> {"reqs": stamped reqs folded so far, "staged":
+        # buffered kvs-command words}, folded DETERMINISTICALLY from
+        # the committed stream like last_req — a PREPARE record stages
+        # its embedded write, the COMMIT record applies the buffer in
+        # staging order, ABORT drops it, MERGE applies immediately.
+        # Writes of an aborted (or never-decided) transaction never
+        # reach the table. Dedup is PER TID (every coordinator record
+        # is uniquely stamped), so the registry holds only live tids:
+        # a finished tid moves to the bounded done-ring below and its
+        # entry here is dropped — where a per-conn high-water registry
+        # would keep one entry per coordinator record forever.
         self._txn_buf: List[dict] = [dict() for _ in range(cluster.R)]
+        self._txn_done: List[set] = [set() for _ in range(cluster.R)]
+        self._txn_done_fifo: List[collections.deque] = [
+            collections.deque() for _ in range(cluster.R)]
         self.txn_applied: List[int] = [0] * cluster.R
         self.txn_discarded: List[int] = [0] * cluster.R
 
@@ -109,6 +129,8 @@ class ReplicatedKVS:
         self.last_req[r] = dict()
         self.deduped[r] = 0
         self._txn_buf[r] = dict()
+        self._txn_done[r] = set()
+        self._txn_done_fifo[r] = collections.deque()
         self.txn_applied[r] = 0
         self.txn_discarded[r] = 0
 
@@ -161,30 +183,71 @@ class ReplicatedKVS:
             cmd = jnp.asarray(np.frombuffer(payload, "<i4"))
             self.tables[r], _ = self._apply_jit(self.tables[r], cmd)
 
+    def _txn_retire(self, r: int, tid: int) -> None:
+        """Move ``tid`` to replica ``r``'s done-ring: late duplicates
+        (retried decisions/merges) and stragglers of a finished
+        transaction are dropped without per-record registry residue."""
+        done = self._txn_done[r]
+        if tid in done:
+            return
+        done.add(tid)
+        fifo = self._txn_done_fifo[r]
+        fifo.append(tid)
+        while len(fifo) > TXN_DONE_CAP:
+            done.discard(fifo.popleft())
+
     def _fold_txn(self, r: int, conn: int, req: int,
                   payload: bytes) -> None:
-        """Fold one committed 2PC record (txn/records.py layout):
+        """Fold one committed txn record (txn/records.py layout):
         PREPARE stages its embedded write per tid, COMMIT applies the
-        tid's staged writes in order, ABORT drops them. Deterministic
-        over the committed stream (same dedup rule as commands), so
-        every replica — and any rebuild — derives the same table."""
+        tid's staged writes in staging order, ABORT drops them, MERGE
+        applies immediately (commutative — no staging needed) and
+        retires the tid once its last merge record lands. Exactly-once
+        is per tid: stamped duplicates dedup against the live tid's
+        req set or the done-ring, NOT the session ``last_req``
+        registry (single-record coordinator conns would grow it
+        forever). A record for an already-finished tid — a retried
+        duplicate, or a PREPARE landing after its transaction's
+        decision — is dropped, so nothing can stage under a dead tid.
+        Deterministic over the committed stream, so every replica —
+        and any rebuild — derives the same table."""
         from rdma_paxos_tpu.txn.records import decode_record
-        if req > 0 and conn > 0:
-            if req <= self.last_req[r].get(conn, 0):
-                self.deduped[r] += 1
-                return
-            self.last_req[r][conn] = req
-        txn_op, tid, _arg, cmd_words = decode_record(payload)
+        txn_op, tid, arg, cmd_words = decode_record(payload)
+        if tid in self._txn_done[r]:
+            self.deduped[r] += 1
+            return
+        stamped = req > 0 and conn > 0
         buf = self._txn_buf[r]
-        if txn_op == TXN_PREPARE:
-            buf.setdefault(tid, []).append(np.asarray(cmd_words))
+        if txn_op in (TXN_PREPARE, TXN_MERGE):
+            ent = buf.setdefault(tid, {"reqs": set(), "staged": []})
+            if stamped:
+                if req in ent["reqs"]:
+                    self.deduped[r] += 1
+                    return
+                ent["reqs"].add(req)
+            if txn_op == TXN_PREPARE:
+                ent["staged"].append(np.asarray(cmd_words))
+                return
+            self.tables[r], _ = self._apply_jit(
+                self.tables[r], jnp.asarray(cmd_words))
+            self.txn_applied[r] += 1
+            if stamped and len(ent["reqs"]) == arg:
+                # the coordinator submits exactly ``arg`` merge
+                # records here — all folded, the tid is complete
+                del buf[tid]
+                self._txn_retire(r, tid)
         elif txn_op == TXN_COMMIT:
-            for cmd in buf.pop(tid, ()):
+            ent = buf.pop(tid, None)
+            for cmd in (ent["staged"] if ent else ()):
                 self.tables[r], _ = self._apply_jit(
                     self.tables[r], jnp.asarray(cmd))
                 self.txn_applied[r] += 1
+            self._txn_retire(r, tid)
         elif txn_op == TXN_ABORT:
-            self.txn_discarded[r] += len(buf.pop(tid, ()))
+            ent = buf.pop(tid, None)
+            self.txn_discarded[r] += (len(ent["staged"]) if ent
+                                      else 0)
+            self._txn_retire(r, tid)
 
     # ------------------------------------------------------------------
 
@@ -209,6 +272,45 @@ class ReplicatedKVS:
         """Open a retransmitting-client session (the UD-client analog)."""
         return ClientSession(self, client_id)
 
+    def serving_path(self, r: int) -> str:
+        """The linearizable serving gate as a standalone check:
+        ``"lease"`` / ``"read_index"`` when replica ``r`` may serve a
+        linearizable read NOW (see :meth:`get` for the two paths),
+        ``"quarantined"`` / ``"refused"`` when it must not. Callers
+        that establish the linearization point themselves (the
+        ReadHub, the txn coordinator's serialization-point reads) pair
+        this with :meth:`serve_local` — unlike :meth:`get`'s ``None``,
+        the gate verdict is never ambiguous with a missing key."""
+        # a quarantined/recovering replica must not serve at all —
+        # not even through a stale leadership_verified snapshot
+        # from the step before its links were cut (the repair
+        # pipeline revokes its lease; this closes the one-step
+        # read-index window too). read_blocked covers the repair
+        # holds need_recovery does not: the storm policy leaves
+        # replay running, and the digest path drops need_recovery
+        # at install time while probation still bars serving.
+        if (r in getattr(self.c, "need_recovery", ())
+                or r in getattr(self.c, "read_blocked", ())):
+            return "quarantined"
+        lm = getattr(self.c, "leases", None)
+        g = self.group if self.group is not None else 0
+        last = self.c.last
+        # the serving frontier gate the hub also enforces: the
+        # local apply cursor must cover the replica's own commit
+        # index, else state already ACKED to writers is missing
+        # from the table (a wedged apply keeps acking windows, so
+        # leadership_verified — and the lease — stay live while
+        # applied freezes below commit)
+        applied = getattr(self.c, "applied", None)
+        caught_up = (last is not None and applied is not None
+                     and int(applied[r])
+                     >= int(last["commit"][r]))
+        if caught_up and lm is not None and lm.valid(g, r):
+            return "lease"
+        if caught_up and last["leadership_verified"][r]:
+            return "read_index"
+        return "refused"
+
     def get(self, r: int, key: bytes, *,
             linearizable: bool = False) -> Optional[bytes]:
         """Read from replica ``r``'s table. A ``linearizable=True``
@@ -230,37 +332,12 @@ class ReplicatedKVS:
                  if self.history is not None else None)
         path = None
         if linearizable:
-            # a quarantined/recovering replica must not serve at all —
-            # not even through a stale leadership_verified snapshot
-            # from the step before its links were cut (the repair
-            # pipeline revokes its lease; this closes the one-step
-            # read-index window too). read_blocked covers the repair
-            # holds need_recovery does not: the storm policy leaves
-            # replay running, and the digest path drops need_recovery
-            # at install time while probation still bars serving.
-            if (r in getattr(self.c, "need_recovery", ())
-                    or r in getattr(self.c, "read_blocked", ())):
+            path = self.serving_path(r)
+            if path == "quarantined":
                 if op_id is not None:
                     self.history.fail(op_id, reason="quarantined")
                 return None
-            lm = getattr(self.c, "leases", None)
-            g = self.group if self.group is not None else 0
-            last = self.c.last
-            # the serving frontier gate the hub also enforces: the
-            # local apply cursor must cover the replica's own commit
-            # index, else state already ACKED to writers is missing
-            # from the table (a wedged apply keeps acking windows, so
-            # leadership_verified — and the lease — stay live while
-            # applied freezes below commit)
-            applied = getattr(self.c, "applied", None)
-            caught_up = (last is not None and applied is not None
-                         and int(applied[r])
-                         >= int(last["commit"][r]))
-            if caught_up and lm is not None and lm.valid(g, r):
-                path = "lease"
-            elif caught_up and last["leadership_verified"][r]:
-                path = "read_index"
-            else:
+            if path == "refused":
                 # a REFUSED read definitively did not happen — fail,
                 # not timeout (the checker drops it, constraint-free)
                 if op_id is not None:

@@ -880,6 +880,7 @@ class SimCluster:
         # ring-full backpressure / deposition: the appended set is a
         # PREFIX of ``taken`` — requeue the remainder in order
         # (submissions to non-leaders are dropped by design)
+        txn_notes = []
         with self._host_lock:
             for r in range(self.R):
                 take = ticket.taken[r]
@@ -887,10 +888,16 @@ class SimCluster:
                     acc_r = int(res["accepted"][r])
                     self._stamp_appends(r, take, acc_r, res)
                     if self.txn is not None and acc_r > 0:
-                        self.txn.note_appends(
-                            0, r, take[:acc_r], int(res["term"][r]),
-                            int(res["end"][r]) + self.rebased_total)
+                        txn_notes.append(
+                            (0, r, take[:acc_r], int(res["term"][r]),
+                             int(res["end"][r]) + self.rebased_total))
                     requeue_shortfall(self.pending[r], take, acc_r)
+        # OUTSIDE _host_lock: note_appends takes the coordinator lock,
+        # which client threads hold while submitting (coordinator ->
+        # cluster order) — calling it from the stamp loop would be the
+        # reverse order, an ABBA deadlock against kvs.transact()
+        for note in txn_notes:
+            self.txn.note_appends(*note)
         if prof is not None:
             prof.start("apply")
         self._replay_committed(

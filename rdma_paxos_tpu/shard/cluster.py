@@ -766,6 +766,7 @@ class ShardedCluster:
                                 if burst or scan else tv)
             _device.accumulate(self.device_counters, res["telemetry"])
             _device.ingest(self.obs, res["telemetry"])
+        txn_notes = []
         with self._host_lock:
             for g in range(G):
                 for r in range(R):
@@ -774,13 +775,20 @@ class ShardedCluster:
                         acc_gr = int(res["accepted"][g, r])
                         self._stamp_appends(g, r, take, acc_gr, res)
                         if self.txn is not None and acc_gr > 0:
-                            self.txn.note_appends(
-                                g, r, take[:acc_gr],
-                                int(res["term"][g, r]),
-                                int(res["end"][g, r])
-                                + int(self.rebased_total[g]))
+                            txn_notes.append(
+                                (g, r, take[:acc_gr],
+                                 int(res["term"][g, r]),
+                                 int(res["end"][g, r])
+                                 + int(self.rebased_total[g])))
                         requeue_shortfall(self.pending[g][r], take,
                                           acc_gr)
+        # coordinator notification OUTSIDE _host_lock: note_appends
+        # takes the coordinator lock, and client threads inside
+        # begin()/observe hold that lock while submitting (which takes
+        # _host_lock) — invoking it from the stamp loop would invert
+        # the coordinator -> cluster lock order into an ABBA deadlock
+        for note in txn_notes:
+            self.txn.note_appends(*note)
         if prof is not None:
             prof.start("apply")
         self._replay_committed(

@@ -24,7 +24,8 @@ onto the dispatch cadence:
   transactions leave no partial writes by construction.
 
 Mergeable-only transactions (``txn/merge.py``) skip all of the above:
-their writes commit as independent per-group commands.
+their writes commit as independent per-group MERGE records, applied
+the moment they fold (no staging, no votes, no decision round).
 
 Concurrency: participant locks are keyed ``(group, key)`` — a
 conflicting admission aborts immediately (no waiting ⟹ no deadlock).
@@ -38,7 +39,6 @@ import collections
 import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
-from rdma_paxos_tpu.models.kvs import OP_PUT, OP_RM, encode_cmd
 from rdma_paxos_tpu.txn import merge as _merge
 from rdma_paxos_tpu.txn import records as _records
 from rdma_paxos_tpu.txn.lane import TXN_CONFLICT, TXN_PREPARED
@@ -206,14 +206,14 @@ class TxnCoordinator:
 
     def _conn(self, g: int, req: int) -> int:
         """PER-RECORD conn id: ``(client_id + req)`` pushed through the
-        shared ShardedKVS group-namespacing. The fold's dedup registry
-        is a per-conn HIGH-WATER mark — it assumes FIFO per conn, which
-        client sessions guarantee (one outstanding) but the coordinator
-        cannot: records of concurrent transactions commit out of order
-        across failover, and a retried record behind a later req would
-        be swallowed as a duplicate. One conn per record makes every
-        record its own single-request session: retries dedup exactly
-        (same conn, same req), ordering across records is free.
+        shared ShardedKVS group-namespacing. Client sessions dedup via
+        the per-conn HIGH-WATER registry, which assumes FIFO per conn —
+        the coordinator cannot promise that (records of concurrent
+        transactions commit out of order across failover), so txn
+        records dedup PER TID inside ``_fold_txn`` instead and never
+        touch ``last_req``; the unique ``(conn, req)`` stamp remains
+        the key the stamp loop (``note_appends``), spans, and the
+        serializability checker's stream dedup all match records by.
         ``client_id`` (1<<20 by default) keeps the range far above real
         clients; ``req`` is unique per group so the mapping stays
         injective."""
@@ -255,10 +255,19 @@ class TxnCoordinator:
 
     # holds-lock: _lock
     def _submit_merge(self, txn: Txn) -> None:
+        # MERGE records (not plain commands): the fold applies them
+        # immediately — still coordination-free — but dedups them per
+        # tid and retires the tid's memory when the ``len(ws)``-th
+        # record lands, so retried merges stay exactly-once WITHOUT
+        # leaving a permanent per-record conn entry in ``last_req``
         for g in txn.groups:
-            for op, key, val in txn.writes_by_group[g]:
-                payload = encode_cmd(op, key, val).tobytes()
-                req = self._submit_record(txn, g, payload, track=True)
+            ws = txn.writes_by_group[g]
+            for op, key, val in ws:
+                self._submit_record(
+                    txn, g,
+                    _records.encode_merge(txn.tid, len(ws), op, key,
+                                          val),
+                    track=True)
 
     # holds-lock: _lock
     def _submit_decision(self, txn: Txn, commit: bool) -> None:
@@ -277,12 +286,14 @@ class TxnCoordinator:
 
     def note_appends(self, g: int, r: int, take: Sequence[tuple],
                      term: int, end_abs: int) -> None:
-        """Stamp-loop hook (cluster.finish, under the host lock): the
-        accepted prefix ``take`` landed at absolute indices
-        ``[end_abs - len(take), end_abs)`` on ``g``'s leader ``r`` —
-        match the coordinator's stamped records to learn each one's
-        ``(term, index)`` and arm the group watch when the last
-        prepare of a group is placed."""
+        """Stamp-loop hook (cluster.finish, invoked AFTER the host
+        lock is released — this method takes the coordinator lock,
+        which client threads hold while submitting, so calling it
+        under the host lock would deadlock ABBA): the accepted prefix
+        ``take`` landed at absolute indices ``[end_abs - len(take),
+        end_abs)`` on ``g``'s leader ``r`` — match the coordinator's
+        stamped records to learn each one's ``(term, index)`` and arm
+        the group watch when the last prepare of a group is placed."""
         with self._lock:
             if not self._outstanding:
                 return
@@ -362,6 +373,11 @@ class TxnCoordinator:
         import numpy as np
         term_now = np.asarray(res["term"])
         for g in txn.prep_appended:
+            if g in txn.prepared:
+                # PREPARED is a quorum fact (committed under the
+                # watched term) — a later term change cannot revoke
+                # it, so a failover here must not abort the txn
+                continue
             seen = self._seen_term[g]
             if seen and int(term_now[g].max()) > seen:
                 self._abort(txn, "failover")
@@ -392,12 +408,40 @@ class TxnCoordinator:
                 self.cluster.clear_txn_watch(g)
         if txn.prepared == set(txn.groups):
             # serialization point: all participants hold the staged
-            # writes durably — fetch the read set under the locks,
-            # then decide commit
+            # writes durably — fetch the read set under the locks
+            # through the LINEARIZABLE serving gate (lease/read-index
+            # + apply-frontier), so captured reads cannot miss writes
+            # committed by non-transactional clients. If a read key's
+            # group cannot serve linearizably this step, retry next
+            # observe — the step-domain deadline is the backstop.
+            reads = {}
             for key in txn.read_keys:
-                txn.reads[key] = self.kvs.get(key)
+                served, val = self._read_serialization_point(key)
+                if not served:
+                    return
+                reads[key] = val
+            txn.reads = reads
             txn.state = COMMITTING
             self._submit_decision(txn, commit=True)
+
+    # holds-lock: _lock
+    def _read_serialization_point(self, key) -> Tuple[bool, Optional[bytes]]:
+        """One read-set fetch at the serialization point: ``(served,
+        value)``. The gate check (``serving_path``) then the bare
+        table read (``serve_local``) is the ReadHub's linearization
+        recipe — unlike ``kvs.get``, a ``None`` value here is
+        unambiguously 'key absent', never 'gate refused'."""
+        g = self.kvs.group_of(key)
+        lm = getattr(self.cluster, "leases", None)
+        r = lm.serving_holder(g) if lm is not None else -1
+        if r < 0:
+            r = self.cluster.leader_hint(g)
+        if r < 0:
+            return False, None
+        kv = self.kvs.groups[g]
+        if kv.serving_path(r) not in ("lease", "read_index"):
+            return False, None
+        return True, kv.serve_local(r, key)
 
     # retry patience (steps) before a decided record not yet appended
     # is resubmitted — covers a deposed/mis-hinted leader that dropped
@@ -496,6 +540,12 @@ class TxnCoordinator:
                 nxt = self._txns.get(self._queue.popleft())
                 if nxt is not None and not nxt.done:
                     self._active_2pc = nxt.tid
+                    # the timeout budget covers the 2PC rounds, not
+                    # the FIFO wait — restart it at promotion or a
+                    # queued txn aborts 'timeout' the moment (or soon
+                    # after) its prepares finally go out
+                    nxt.deadline = (self.cluster.step_index
+                                    + self.timeout_steps)
                     self._submit_prepares(nxt)
                     break
 

@@ -5,12 +5,14 @@ prepare phase: each op is a commutative, associative fold into the
 current value (``models/kvs.py`` ops 4-6), so per-group entries commit
 independently in ANY interleaving and converge to the same state — the
 replicated-data-type argument of SafarDB (arXiv:2603.08003). The
-coordinator detects this shape and submits one plain stamped command
-per group instead of the PREPARE/COMMIT record pair; atomicity demotes
-to eventual all-or-nothing via the session retransmit rule (every
-group's command is retried under its original ``(conn, req)`` until
-committed), which is exactly the guarantee merges need — there is no
-intermediate state a reader could tear.
+coordinator detects this shape and submits one stamped MERGE record
+per write (``txn/records.py``) instead of the PREPARE/COMMIT record
+pair; the fold applies a MERGE the moment it commits — no staging, no
+votes. Atomicity demotes to eventual all-or-nothing via the retry
+rule (every record is retried under its original ``(conn, req)``
+until committed, deduped per tid by the fold), which is exactly the
+guarantee merges need — there is no intermediate state a reader could
+tear.
 
 Host-side helpers only — device folds live in ``models/kvs.py``.
 """

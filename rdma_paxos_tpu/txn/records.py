@@ -18,10 +18,17 @@ skips them without decoding. Layout (int32 words, little-endian):
   in staging order, then drops the buffer.
 * ``ABORT``: ``arg`` = an abort-reason code (host telemetry only);
   the fold drops the buffer unapplied.
+* ``MERGE``: one mergeable fast-path write (txn/merge.py); ``arg`` =
+  how many merge records its transaction submits to THIS group. The
+  fold applies the embedded command immediately (commutative — no
+  staging) and retires the tid's dedup memory once all ``arg``
+  records have folded, so the fast path stays coordination-free AND
+  leaves no per-record registry residue.
 
-Mergeable fast-path writes (txn/merge.py) do NOT use these records:
-they commit as plain CMD_W commands with a mergeable op code —
-commutative folds need no staging.
+Exactly-once for ALL of these is per tid, not per session: every
+record's ``(conn, req)`` stamp is unique, the fold remembers only the
+reqs of live tids, and a tid's memory is dropped with its decision
+(or last merge record) — see ``ReplicatedKVS._fold_txn``.
 """
 
 from __future__ import annotations
@@ -30,7 +37,7 @@ import numpy as np
 
 from rdma_paxos_tpu.models.kvs import CMD_W, encode_cmd
 
-TXN_PREPARE, TXN_COMMIT, TXN_ABORT = 1, 2, 3
+TXN_PREPARE, TXN_COMMIT, TXN_ABORT, TXN_MERGE = 1, 2, 3, 4
 TXN_CMD_W = 3 + CMD_W
 
 # ABORT-record reason codes (mirrors the txn_aborted_total labels)
@@ -55,6 +62,16 @@ def encode_abort(tid: int, reason: int) -> bytes:
     return np.concatenate([
         np.array([TXN_ABORT, tid, reason], "<i4"),
         np.zeros(CMD_W, "<i4")]).astype("<i4").tobytes()
+
+
+def encode_merge(tid: int, n_of: int, op: int, key: bytes,
+                 val: bytes = b"") -> bytes:
+    """One mergeable fast-path write of ``tid`` on this group;
+    ``n_of`` = the transaction's total merge-record count here (the
+    fold's retire trigger)."""
+    return np.concatenate([
+        np.array([TXN_MERGE, tid, n_of], "<i4"),
+        encode_cmd(op, key, val)]).astype("<i4").tobytes()
 
 
 def decode_record(payload: bytes):
