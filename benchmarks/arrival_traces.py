@@ -18,11 +18,46 @@ Stdlib only (the benches import this before jax config lands).
 
 from __future__ import annotations
 
+import bisect
 import math
 import random
 from typing import List
 
 SHAPES = ("bursty", "diurnal", "step")
+
+
+def zipf_keys(n_ops: int, *, s: float = 1.2, n_keys: int = 64,
+              seed: int = 0, prefix: bytes = b"key") -> List[bytes]:
+    """``n_ops`` key draws, Zipf(``s``)-distributed over a pool of
+    ``n_keys`` distinct keys — the KEY-shape companion to
+    :func:`make_trace`'s arrival shapes.
+
+    Rank ``i`` (0 = hottest) is drawn with probability proportional to
+    ``1/(i+1)**s``; key NAMES are a seeded shuffle of ``prefix +
+    b"%06d" % j`` over the ranks, so hotness is scattered across the
+    byte order the way real keyspaces scatter it (a byte-range carve
+    of any region carries real weight — rank-ordered names would hide
+    all the heat below every median). Inverse-CDF sampling over the
+    exact finite harmonic mass — stdlib only, bit-identical for a
+    given ``(n_ops, s, n_keys, seed, prefix)`` via the same
+    string-seeded RNG discipline as the arrival shapes.
+    """
+    n_ops, n_keys = int(n_ops), int(n_keys)
+    if n_keys <= 0:
+        raise ValueError("zipf_keys: n_keys must be positive")
+    rng = random.Random(
+        f"zipf:{s}:{n_keys}:{seed}:{prefix.decode('latin-1')}")
+    names = list(range(n_keys))
+    rng.shuffle(names)
+    pool = [prefix + b"%06d" % j for j in names]
+    cdf: List[float] = []
+    acc = 0.0
+    for i in range(n_keys):
+        acc += 1.0 / float(i + 1) ** s
+        cdf.append(acc)
+    total = cdf[-1]
+    return [pool[bisect.bisect_left(cdf, rng.random() * total)]
+            for _ in range(n_ops)]
 
 
 def make_trace(shape: str, ticks: int, *, seed: int = 0,

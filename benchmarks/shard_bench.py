@@ -34,6 +34,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 def run_one(G: int, *, replicas: int, steps: int, payload: int,
             burst: bool, json_path, cfg=None, mesh=None,
             telemetry: bool = False, read_ratio: float = 0.0,
+            zipf: float = 0.0, zipf_n_keys: int = 64,
             metric="shard_aggregate_committed_ops_per_sec",
             extra_detail=None, obs=None, on_cluster=None):
     """Build, warm, and drive one G-group cluster; returns the result
@@ -88,7 +89,33 @@ def run_one(G: int, *, replicas: int, steps: int, payload: int,
         reads_per_step = max(1, min(
             int(B * read_ratio / max(1.0 - read_ratio, 1e-6)), 4 * B))
 
+    # --zipf S: the offered load becomes KEY-shaped — each step offers
+    # G*B ops whose keys are drawn Zipf(S) over a fixed pool and routed
+    # by the router, so hot groups saturate their per-step batch while
+    # cold ones idle. The row's zipf column carries offered vs admitted
+    # per group — the skew the elastic-topology bench exists to fix.
+    zipf_offered = [0] * G
+    zipf_admitted = [0] * G
+    if zipf:
+        from benchmarks.arrival_traces import zipf_keys
+        ztrace = zipf_keys((steps + 4) * G * B, s=zipf,
+                           n_keys=zipf_n_keys, seed=0)
+        key_group = {k: sc.router.group_of(k) for k in set(ztrace)}
+        zstate = dict(pos=0)
+
     def feed():
+        if zipf:
+            sent = [0] * G
+            take = ztrace[zstate["pos"]:zstate["pos"] + G * B]
+            zstate["pos"] += len(take)
+            for k in take:
+                g = key_group[k]
+                zipf_offered[g] += 1
+                if sent[g] < B:
+                    sent[g] += 1
+                    zipf_admitted[g] += 1
+                    sc.submit(g, sc.leader_hint(g), blob)
+            return
         for g in range(G):
             lead = sc.leader_hint(g)
             for i in range(B):
@@ -109,6 +136,9 @@ def run_one(G: int, *, replicas: int, steps: int, payload: int,
     n_dispatch_steps = 0
     reads_by_group = [0] * G
     reads_by_replica = [0] * replicas
+    # zipf column: report the TIMED window only, not warmup
+    zipf_offered = [0] * G
+    zipf_admitted = [0] * G
     t0 = time.perf_counter()
     for _ in range(steps):
         feed()
@@ -186,6 +216,18 @@ def run_one(G: int, *, replicas: int, steps: int, payload: int,
             # spreads with them instead of piling onto one replica
             reads_per_replica=reads_by_replica,
             lease_holders=sc.leases.holders())
+    if zipf:
+        # honest skew reporting: offered is the trace's routing truth,
+        # admitted is what fit the per-step batch — the gap IS the
+        # hot-group ceiling a static G cannot lift
+        off_total = max(sum(zipf_offered), 1)
+        detail["zipf"] = dict(
+            s=zipf, n_keys=zipf_n_keys,
+            offered_per_group=zipf_offered,
+            admitted_per_group=zipf_admitted,
+            dropped_total=sum(zipf_offered) - sum(zipf_admitted),
+            hottest_offered_share=round(
+                max(zipf_offered) / off_total, 3))
     if extra_detail:
         detail.update(extra_detail)
     row = emit(metric, round(committed / dt, 1), "ops/s",
@@ -305,6 +347,14 @@ def main(argv=None) -> int:
                          "leaseholder alongside the write feed — the "
                          "per-group read fan-out shows up as "
                          "reads_per_replica in every row")
+    ap.add_argument("--zipf", type=float, default=0.0, metavar="S",
+                    help="key-shaped offered load: draw each step's "
+                         "G*B ops from a Zipf(S) key pool routed by "
+                         "the router (hot groups saturate, cold ones "
+                         "idle) — adds the offered/admitted skew "
+                         "column to every row")
+    ap.add_argument("--zipf-keys", type=int, default=64,
+                    help="distinct keys in the --zipf pool")
     ap.add_argument("--json", default=None,
                     help="append JSON result rows to this file")
     ap.add_argument("--serve-metrics", nargs="?", const=0,
@@ -387,6 +437,7 @@ def main(argv=None) -> int:
                       payload=args.payload, burst=args.burst,
                       json_path=args.json,
                       read_ratio=args.read_ratio,
+                      zipf=args.zipf, zipf_n_keys=args.zipf_keys,
                       obs=shared_obs, on_cluster=on_cluster)
         scaling[G] = row
     emit("shard_scaling",

@@ -62,6 +62,8 @@ LOCK_MODULES = (
     "rdma_paxos_tpu/streams/__init__.py",
     "rdma_paxos_tpu/streams/scan.py",
     "rdma_paxos_tpu/streams/watch.py",
+    "rdma_paxos_tpu/topology/transition.py",
+    "rdma_paxos_tpu/topology/policy.py",
 )
 
 _GUARD_RE = re.compile(

@@ -132,6 +132,18 @@ HOST_PURE_MODULES: Dict[str, dict] = {
     "rdma_paxos_tpu/streams/cdc.py": dict(
         ban_imports=("jax", "jaxlib", "numpy"),
         patterns=(r"\bjax\b", r"\bjnp\b", r"shard_map")),
+    # elastic topology: the shared epoch/completion-proof helpers and
+    # the load policy are pure host control plane — splits reshape
+    # host routing only, so neither may ever grow a device dependency
+    # (zero new STEP_CACHE keys is pinned by test on top of this).
+    # Import-level bans only for the jax root: both docstrings
+    # legitimately NAME jax to forbid it.
+    "rdma_paxos_tpu/topology/epoch.py": dict(
+        ban_imports=("jax", "jaxlib"),
+        patterns=(r"\bjnp\b", r"shard_map")),
+    "rdma_paxos_tpu/topology/policy.py": dict(
+        ban_imports=("jax", "jaxlib", "numpy"),
+        patterns=(r"\bjnp\b", r"shard_map", r"\bbuild_")),
 }
 
 

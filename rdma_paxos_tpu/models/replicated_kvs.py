@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import collections
 import time
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -408,6 +408,32 @@ class ReplicatedKVS:
                 v = decode_val(vals[j])
                 out.append(v if v else None)
             i += len(chunk)
+        return out
+
+    def items_in_range(self, r: int, lo: bytes,
+                       hi: Optional[bytes]) -> List[Tuple[bytes, bytes]]:
+        """Every live ``(key, value)`` pair in ``[lo, hi)`` (byte-
+        lexicographic; ``hi=None`` = unbounded) from replica ``r``'s
+        folded table, sorted by key — the topology transition's
+        donor-side enumeration primitive (what must be seeded into a
+        migrating range's new owner, and the input to its range
+        digest). Host-side table walk, no device dispatch. Keys come
+        back canonicalized modulo trailing NULs (the fixed-width table
+        cannot represent them — same equivalence the KVS itself
+        applies)."""
+        self._fold(r)
+        kv = self.tables[r]
+        used = np.asarray(kv.used)
+        keys = np.asarray(kv.keys)
+        vals = np.asarray(kv.vals)
+        out: List[Tuple[bytes, bytes]] = []
+        for slot in np.nonzero(used)[0]:
+            kb = keys[slot].astype("<i4").tobytes().rstrip(b"\x00")
+            if kb < lo or (hi is not None and kb >= hi):
+                continue
+            out.append(
+                (kb, vals[slot].astype("<i4").tobytes().rstrip(b"\x00")))
+        out.sort()
         return out
 
     def submit_get(self, leader: int, key: bytes, *, client_id: int,

@@ -217,6 +217,61 @@ def _quantile(hists: Sequence[dict], q: float) -> Optional[float]:
     return float("inf")
 
 
+def _validate_rule(r: dict, seen_names) -> None:
+    """Reject an incomplete/unknown rule at registration time — the
+    one place a bad rule may raise (see the engine constructor)."""
+    if "name" not in r or "metric" not in r:
+        raise ValueError(f"rule missing name/metric: {r}")
+    if r.get("kind") not in KINDS:
+        raise ValueError(
+            f"rule {r['name']!r}: unknown kind {r.get('kind')!r}"
+            f" (known: {KINDS})")
+    if r["name"] in seen_names:
+        raise ValueError(f"duplicate rule name {r['name']!r}")
+    kind = r["kind"]
+    if kind == "gauge_cmp":
+        if r.get("op") not in _OPS or "value" not in r:
+            raise ValueError(
+                f"rule {r['name']!r}: gauge_cmp needs op in "
+                f"{sorted(_OPS)} and a value")
+    elif kind == "hist_quantile":
+        if "threshold" not in r:
+            raise ValueError(
+                f"rule {r['name']!r}: hist_quantile needs a "
+                "threshold")
+        if r.get("op", ">") not in _OPS:
+            raise ValueError(
+                f"rule {r['name']!r}: bad op {r.get('op')!r}")
+    elif kind == "rate_window":
+        if "threshold" not in r:
+            raise ValueError(
+                f"rule {r['name']!r}: rate_window needs a "
+                "threshold")
+        if not (r.get("window_s") or r.get("window_steps")):
+            raise ValueError(
+                f"rule {r['name']!r}: rate_window needs "
+                "window_s or window_steps")
+        if r.get("op", ">") not in _OPS:
+            raise ValueError(
+                f"rule {r['name']!r}: bad op {r.get('op')!r}")
+    elif kind == "burn_rate":
+        for field in ("bound", "objective", "fast_window_s",
+                      "slow_window_s"):
+            if field not in r:
+                raise ValueError(
+                    f"rule {r['name']!r}: burn_rate needs "
+                    f"{field}")
+        if not 0.0 < float(r["objective"]) < 1.0:
+            raise ValueError(
+                f"rule {r['name']!r}: objective must be in "
+                "(0, 1)")
+        if float(r["slow_window_s"]) <= float(
+                r["fast_window_s"]):
+            raise ValueError(
+                f"rule {r['name']!r}: slow_window_s must "
+                "exceed fast_window_s")
+
+
 class AlertEngine:
     """Evaluates a declarative rule list against registry snapshots,
     with per-rule hysteresis and firing-state export."""
@@ -234,62 +289,14 @@ class AlertEngine:
                                         else default_rules())]
         seen = set()
         for r in self.rules:
-            if "name" not in r or "metric" not in r:
-                raise ValueError(f"rule missing name/metric: {r}")
-            if r.get("kind") not in KINDS:
-                raise ValueError(
-                    f"rule {r['name']!r}: unknown kind {r.get('kind')!r}"
-                    f" (known: {KINDS})")
-            if r["name"] in seen:
-                raise ValueError(f"duplicate rule name {r['name']!r}")
-            seen.add(r["name"])
             # kind-specific completeness is checked HERE, not at
             # evaluation time: the engine runs inside the driver poll
             # loop, where a KeyError would be a fatal step crash that
-            # fails every inflight commit — construction is the only
-            # place a bad rule may raise
-            kind = r["kind"]
-            if kind == "gauge_cmp":
-                if r.get("op") not in _OPS or "value" not in r:
-                    raise ValueError(
-                        f"rule {r['name']!r}: gauge_cmp needs op in "
-                        f"{sorted(_OPS)} and a value")
-            elif kind == "hist_quantile":
-                if "threshold" not in r:
-                    raise ValueError(
-                        f"rule {r['name']!r}: hist_quantile needs a "
-                        "threshold")
-                if r.get("op", ">") not in _OPS:
-                    raise ValueError(
-                        f"rule {r['name']!r}: bad op {r.get('op')!r}")
-            elif kind == "rate_window":
-                if "threshold" not in r:
-                    raise ValueError(
-                        f"rule {r['name']!r}: rate_window needs a "
-                        "threshold")
-                if not (r.get("window_s") or r.get("window_steps")):
-                    raise ValueError(
-                        f"rule {r['name']!r}: rate_window needs "
-                        "window_s or window_steps")
-                if r.get("op", ">") not in _OPS:
-                    raise ValueError(
-                        f"rule {r['name']!r}: bad op {r.get('op')!r}")
-            elif kind == "burn_rate":
-                for field in ("bound", "objective", "fast_window_s",
-                              "slow_window_s"):
-                    if field not in r:
-                        raise ValueError(
-                            f"rule {r['name']!r}: burn_rate needs "
-                            f"{field}")
-                if not 0.0 < float(r["objective"]) < 1.0:
-                    raise ValueError(
-                        f"rule {r['name']!r}: objective must be in "
-                        "(0, 1)")
-                if float(r["slow_window_s"]) <= float(
-                        r["fast_window_s"]):
-                    raise ValueError(
-                        f"rule {r['name']!r}: slow_window_s must "
-                        "exceed fast_window_s")
+            # fails every inflight commit — construction (and
+            # add_rule, the same gate) is the only place a bad rule
+            # may raise
+            _validate_rule(r, seen)
+            seen.add(r["name"])
         self._lock = threading.Lock()
         # alert→action hooks: fn(name, severity) called on each FIRE
         # transition (outside the engine lock; exceptions are swallowed
@@ -468,6 +475,20 @@ class AlertEngine:
         """Register an alert→action hook ``fn(name, severity)`` —
         invoked on every fire transition, after state/trace export."""
         self._hooks.append(fn)
+
+    def add_rule(self, rule: dict) -> None:
+        """Register one more rule after construction — the attach path
+        for subsystems that ship their own stock rules (topology skew).
+        Same validation gate as the constructor; duplicate names are
+        rejected so a double attach can't shadow state."""
+        r = dict(rule)
+        _validate_rule(r, {x["name"] for x in self.rules})
+        with self._lock:
+            self.rules.append(r)
+            self._st[r["name"]] = dict(
+                severity=r.get("severity", WARN), firing=False,
+                pending=0, value=None, since_eval=None, since=None,
+                duration_s=None, fired_count=0)
 
     # ---------------- state export ----------------
 

@@ -159,6 +159,20 @@ def _txn_state(health: dict) -> str:
     return s
 
 
+def _topo_state(health: dict) -> str:
+    """Elastic-topology column: current epoch + lifetime transitions,
+    plus the live window phase while one is open (``topology`` health
+    entry — absent on clusters without a controller)."""
+    topo = health.get("topology")
+    if not topo:
+        return "-"
+    s = f"e{topo.get('epoch', 0)}/{topo.get('transitions_total', 0)}t"
+    phase = topo.get("phase")
+    if phase and phase != "idle":
+        s += f" {topo.get('direction', '?')}:{phase}"
+    return s
+
+
 def _firing_alerts(state: Optional[dict]) -> List[dict]:
     out = []
     for name, st in (state or {}).items():
@@ -212,7 +226,8 @@ def fleet_view(sources: List[dict]) -> dict:
                     apply=_imax(grp.get("apply") or []),
                     reads=(reads if g == 0 else {}),
                     repair=_repair_state(h),
-                    txn=(_txn_state(h) if g == 0 else "-")))
+                    txn=(_txn_state(h) if g == 0 else "-"),
+                    topo=(_topo_state(h) if g == 0 else "-")))
         elif isinstance(h.get("replicas"), list):   # single-group
             hosts.append(dict(src=src, kind="cluster", age_s=age,
                               loop_error=h.get("loop_error")))
@@ -226,7 +241,8 @@ def fleet_view(sources: List[dict]) -> dict:
                 apply=_imax(r.get("apply") for r in reps),
                 reads=_reads_by_path(h),
                 repair=_repair_state(h),
-                txn=_txn_state(h)))
+                txn=_txn_state(h),
+                topo=_topo_state(h)))
         elif "replica" in h:                        # one member file
             hosts.append(dict(src=src, kind="replica",
                               replica=h.get("replica"), age_s=age))
@@ -248,7 +264,7 @@ def fleet_view(sources: List[dict]) -> dict:
             term=_imax(h.get("term") for _, h in members),
             commit=_imax(h.get("commit") for _, h in members),
             apply=_imax(h.get("apply") for _, h in members),
-            reads={}, repair="-", txn="-",
+            reads={}, repair="-", txn="-", topo="-",
             members=len(members)))
 
     # dedupe alerts by name, keeping the longest-firing instance
@@ -286,7 +302,7 @@ def render_table(view: dict, prev: Optional[dict] = None) -> str:
             prev_reads[(r["src"], r["group"])] = r["reads"]
     hdr = (f"{'GROUP':<6} {'LEADER':<7} {'LEASE':<6} {'TERM':<6} "
            f"{'COMMIT':<10} {'APPLY':<10} {'REPAIR':<14} "
-           f"{'TXN':<12} READS")
+           f"{'TXN':<12} {'TOPO':<12} READS")
     lines = [hdr, "-" * len(hdr)]
     for r in view["groups"]:
         def cell(v, dash="-"):
@@ -297,6 +313,7 @@ def render_table(view: dict, prev: Optional[dict] = None) -> str:
             f"{cell(r['commit']):<10} {cell(r['apply']):<10} "
             f"{str(r['repair']):<14} "
             f"{str(r.get('txn', '-')):<12} "
+            f"{str(r.get('topo', '-')):<12} "
             + _fmt_reads(r["reads"],
                          prev_reads.get((r["src"], r["group"])), dt))
     if view["alerts"]:

@@ -69,6 +69,13 @@ GOVERNOR_TIER = "governor_tier"          # dispatch tier changed
 GOVERNOR_SHED = "governor_shed"          # SLO burn pager dropped tier to serial
 GOVERNOR_RESUME = "governor_resume"      # shed latch cleared (pager resolved)
 IDLE_QUIESCE = "idle_quiesce"            # poll loop entered idle quiescence
+TOPOLOGY_PROPOSED = "topology_proposed"  # policy proposed a split/merge
+TOPOLOGY_SEEDED = "topology_seeded"      # migrating range copied to targets
+TOPOLOGY_VERIFIED = "topology_verified"  # range digests matched pre-cutover
+TOPOLOGY_FROZEN = "topology_frozen"      # migrating-range writes queued
+TOPOLOGY_CUTOVER = "topology_cutover"    # router swapped, epoch bumped
+TOPOLOGY_DONE = "topology_done"          # transition window closed
+TOPOLOGY_ABANDONED = "topology_abandoned"  # window gave up (deadline)
 
 
 class TraceEvent(NamedTuple):

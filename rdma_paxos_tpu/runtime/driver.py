@@ -640,6 +640,12 @@ class ClusterDriver:
         # contract _drive_config_change uses)
         if self.repair is not None:
             self.repair.drive()
+        # elastic topology: transition passes (seed/freeze/cutover)
+        # run on the same drained serial path, after repair (repair
+        # gets priority; the window defers or abandons around it)
+        topo = getattr(self.cluster, "topology", None)
+        if topo is not None:
+            topo.drive()
 
     def _pump_submitq(self) -> None:
         """Move intake rows into the engine's pending queues — ONE
@@ -1676,6 +1682,12 @@ class ClusterDriver:
         # decision records ride SERIAL dispatches only (the same
         # give-way rule elections and repair follow)
         if c.txn is not None and c.txn.wants_serial():
+            return False
+        # an open topology transition window runs its passes on the
+        # drained serial path every iteration (seed → freeze →
+        # cutover) — hold pipelining for the whole window
+        topo = getattr(c, "topology", None)
+        if topo is not None and topo.needs_drain():
             return False
         # the governor engages/disengages depth-D pipelining: until
         # backlog has STOOD for engage_evals (or while shedding), the

@@ -223,6 +223,13 @@ class DispatchGovernor:
                 backlog[g] += int(sb[g])
         accepted = self._accepted(res)
         scan = bool(getattr(cluster, "scan", False))
+        # an open elastic-topology transition window holds the serial
+        # tier: its seed/freeze/cutover passes ride drained serial
+        # dispatches (the txn wants_serial give-way rule). The ladder
+        # state keeps evaluating underneath, so the tier re-climbs on
+        # the first eval after the window closes.
+        topo = getattr(cluster, "topology", None)
+        hold = bool(topo is not None and topo.in_window())
         with self._lock:
             self.evals += 1
             if self.alerts is not None and self._shed:
@@ -261,7 +268,8 @@ class DispatchGovernor:
                     self._advance_rung_locked(
                         g, max(backlog[g], rate))
             prev = self.decision
-            dec = self._publish_locked(backlog, arrivals, scan=scan)
+            dec = self._publish_locked(backlog, arrivals, scan=scan,
+                                       hold_serial=hold)
         self._emit(prev, dec, backlog, arrivals)
 
     def _advance_rung_locked(self, g: int, demand: int) -> None:
@@ -296,7 +304,8 @@ class DispatchGovernor:
     # holds-lock: _lock
     def _publish_locked(self, backlog: List[int],
                         arrivals: List[int],
-                        scan: bool = False) -> Decision:
+                        scan: bool = False,
+                        hold_serial: bool = False) -> Decision:
         if self._pinned is not None:
             kind, k = self._pinned
             dec = Decision(kind, k, k > 1 and not self._shed, 0,
@@ -306,6 +315,12 @@ class DispatchGovernor:
         if self._shed:
             dec = SERIAL._replace(shed=True,
                                   rungs=(1,) * self.G)
+            self.decision = dec
+            return dec
+        if hold_serial:
+            # topology window open: serial, but NOT a shed (no latch,
+            # no pager semantics) — the rung state stays put
+            dec = SERIAL._replace(rungs=(1,) * self.G)
             self.decision = dec
             return dec
         rungs = tuple(self.ladder[r] for r in self._rung)

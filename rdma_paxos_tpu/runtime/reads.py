@@ -323,6 +323,14 @@ class LeaseManager:
                 return False
             return self._revoke_locked(group, reason)
 
+    def revoke_any(self, group: int, reason: str) -> bool:
+        """Revoke whatever lease ``group`` currently has, holder
+        unknown to the caller — the topology cutover's fence (serving
+        through a lease granted under the OLD routing must provably
+        stop before the router swaps). No-op when nothing is held."""
+        with self._lock:
+            return self._revoke_locked(group, reason)
+
     def revoke_all(self, replica: int, reason: str) -> int:
         """Revoke every group lease ``replica`` holds (driver
         step-down / crash paths)."""

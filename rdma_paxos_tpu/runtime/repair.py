@@ -360,6 +360,15 @@ class RepairController:
         repaired this call (chaos runners reset their invariant
         baselines for them)."""
         c = self.cluster
+        topo = getattr(c, "topology", None)
+        if topo is not None and topo.frozen():
+            # a topology cutover is mid-freeze: repair's config
+            # surgery must not interleave with the router swap — give
+            # way for the (step-bounded) freeze. Symmetric rule: the
+            # topology window abandons its freeze the moment repair
+            # quarantines a replica in an affected group, so neither
+            # side can wait the other out.
+            return []
         with c._host_lock:
             if c._tickets:
                 return []           # defer until the pipeline drains

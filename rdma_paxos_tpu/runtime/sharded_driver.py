@@ -140,6 +140,9 @@ class ShardedClusterDriver(ClusterDriver):
                                         hi=group_timer_hi)
                          for g in range(self.G)]
         self._elect_round = [0] * self.G
+        # elastic-topology cutover hook: the controller calls this on
+        # the driver thread right after the atomic router swap
+        self.cluster._on_topology_cutover = self._on_topology_cutover
 
     def _make_cluster(self, cfg, n_replicas, group_size, mode, fanout,
                       audit, telemetry, txn=False):
@@ -308,6 +311,14 @@ class ShardedClusterDriver(ClusterDriver):
         return sum(len(dq) for row in self._inflight_g for dq in row)
 
     def _busy(self) -> bool:
+        # checked OUTSIDE self._lock: the topology cutover hook runs
+        # with the controller's lock held and takes self._lock
+        # (topology._lock -> driver._lock); nesting the reverse order
+        # here would deadlock
+        topo = getattr(self.cluster, "topology", None)
+        if topo is not None and (topo.needs_drain() or topo.cooling()):
+            return True     # keep stepping so the window's records
+            # land and the bounded post-window cooldown expires
         with self._lock:
             return bool(any(self._submitq) or self._backlog()
                         or self._waiter_count()
@@ -391,6 +402,11 @@ class ShardedClusterDriver(ClusterDriver):
         # rule elections and repair follow)
         if c.txn is not None and c.txn.wants_serial():
             return False
+        # an open topology transition window holds the serial path
+        # (checked before self._lock — see _busy's lock-order note)
+        topo = getattr(c, "topology", None)
+        if topo is not None and topo.needs_drain():
+            return False
         # the governor engages/disengages pipelining (see
         # ClusterDriver._pipeline_ready)
         if (self.governor is not None
@@ -464,6 +480,26 @@ class ShardedClusterDriver(ClusterDriver):
                 # terminal failover status on the failed waiters'
                 # spans (group-namespaced track) — never leaked
                 self.obs.spans.fail_open(self._span_rep(g, r))
+
+    def _on_topology_cutover(self, donors, targets) -> None:
+        """An elastic cutover just swapped the live router: some keys
+        moved OFF every group in ``donors``. Their blocked commit
+        waiters are failed (clients retry and re-resolve the owner —
+        same contract as a leadership change) and proxy conn->group
+        pins on donor groups are dropped so the next SEND re-routes
+        under the new map. Held CONNECTs stay held: they carry no key
+        and route with their first SEND. Invoked by the topology
+        controller (its lock held) on the driver thread — we take
+        self._lock here, fixing the topology._lock -> driver._lock
+        order the _busy/_pipeline_ready gates respect by checking
+        ``needs_drain()`` OUTSIDE self._lock."""
+        for g in donors:
+            self._fail_group_inflight(g, "topology cutover")
+        with self._lock:
+            stale = [c for c, g in self._conn_group.items()
+                     if g in donors]
+            for c in stale:
+                del self._conn_group[c]
 
     def _fail_inflight_locked(self, rt, site: str) -> None:
         """Fail EVERY group's blocked waiters on this replica (caller

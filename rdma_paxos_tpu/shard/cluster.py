@@ -272,6 +272,12 @@ class ShardedCluster:
         # every finish(), after the governor — same contract as
         # SimCluster. Host bookkeeping only.
         self.txn = None
+        # elastic topology controller (topology/transition.py,
+        # attached via topology.attach_topology): fed record
+        # placements from the stamp loop (same outside-the-host-lock
+        # contract as txn) and observed at the finish() tail, after
+        # txn. Host bookkeeping only — zero device changes.
+        self.topology = None
         # repair-held replicas barred from read serving ({(g, r)} —
         # see SimCluster.read_blocked)
         self.read_blocked: set = set()
@@ -774,7 +780,9 @@ class ShardedCluster:
                     if take and res["role"][g, r] == int(Role.LEADER):
                         acc_gr = int(res["accepted"][g, r])
                         self._stamp_appends(g, r, take, acc_gr, res)
-                        if self.txn is not None and acc_gr > 0:
+                        if ((self.txn is not None
+                             or self.topology is not None)
+                                and acc_gr > 0):
                             txn_notes.append(
                                 (g, r, take[:acc_gr],
                                  int(res["term"][g, r]),
@@ -782,13 +790,17 @@ class ShardedCluster:
                                  + int(self.rebased_total[g])))
                         requeue_shortfall(self.pending[g][r], take,
                                           acc_gr)
-        # coordinator notification OUTSIDE _host_lock: note_appends
-        # takes the coordinator lock, and client threads inside
-        # begin()/observe hold that lock while submitting (which takes
-        # _host_lock) — invoking it from the stamp loop would invert
-        # the coordinator -> cluster lock order into an ABBA deadlock
+        # coordinator/topology notification OUTSIDE _host_lock:
+        # note_appends takes the coordinator (or controller) lock, and
+        # client threads inside begin()/observe hold that lock while
+        # submitting (which takes _host_lock) — invoking it from the
+        # stamp loop would invert the coordinator -> cluster lock
+        # order into an ABBA deadlock
         for note in txn_notes:
-            self.txn.note_appends(*note)
+            if self.txn is not None:
+                self.txn.note_appends(*note)
+            if self.topology is not None:
+                self.topology.note_appends(*note)
         if prof is not None:
             prof.start("apply")
         self._replay_committed(
@@ -822,6 +834,8 @@ class ShardedCluster:
             self.governor.observe(self, res)
         if self.txn is not None:
             self.txn.observe(self, res)
+        if self.topology is not None:
+            self.topology.observe(self, res)
         if burst or scan:
             self._staging.release(ticket.bufs, [
                 ((k, g, r), min(B, len(t) - k * B))
@@ -1192,7 +1206,9 @@ class ShardedCluster:
                     audit=(self.auditor.summary()
                            if self.auditor is not None else None),
                     leases=(self.leases.status()
-                            if self.leases is not None else None))
+                            if self.leases is not None else None),
+                    topology=(self.topology.status()
+                              if self.topology is not None else None))
 
     # ---------------- leadership ----------------
 

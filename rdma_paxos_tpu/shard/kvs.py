@@ -147,6 +147,17 @@ class ShardedKVS:
             raise RuntimeError(f"group {g} has no known leader")
         return lead
 
+    def _gate(self, key: bytes) -> None:
+        """Topology freeze gate: while an elastic cutover has ``key``'s
+        range frozen, WRITES to it queue here (block) until the router
+        swap lands or the window abandons — the only moment a key's
+        group assignment may change out from under a submission. Reads
+        never gate (the live router serves the old owner up to the
+        atomic swap)."""
+        topo = getattr(self.shard, "topology", None)
+        if topo is not None:
+            topo.gate_key(key)
+
     # ---------------- client API ----------------
 
     def put(self, key: bytes, val: bytes, *, client_id: int = 0,
@@ -155,6 +166,7 @@ class ShardedKVS:
         leader, or ``leader`` when given). A stamped ``client_id`` is
         namespaced via :meth:`conn_for` — consistent with sessions.
         Returns the group id."""
+        self._gate(key)
         g = self.group_of(key)
         self.groups[g].put(self._leader(g, leader), key, val,
                            client_id=self.conn_for(client_id, g),
@@ -163,6 +175,7 @@ class ShardedKVS:
 
     def remove(self, key: bytes, *, client_id: int = 0,
                req_id: int = 0, leader: Optional[int] = None) -> int:
+        self._gate(key)
         g = self.group_of(key)
         self.groups[g].remove(self._leader(g, leader), key,
                               client_id=self.conn_for(client_id, g),
@@ -242,6 +255,7 @@ class ShardedSession:
             leader: Optional[int] = None) -> tuple:
         """Submit a PUT; returns ``(group, req_id)`` — keep the pair to
         retransmit after a timeout or that group's leader failover."""
+        self.kvs._gate(key)
         g = self.kvs.group_of(key)
         rid = self._group_session(g).put(
             self.kvs._leader(g, leader), key, val)
@@ -249,6 +263,7 @@ class ShardedSession:
 
     def remove(self, key: bytes, *,
                leader: Optional[int] = None) -> tuple:
+        self.kvs._gate(key)
         g = self.kvs.group_of(key)
         rid = self._group_session(g).remove(
             self.kvs._leader(g, leader), key)
@@ -259,6 +274,7 @@ class ShardedSession:
         """Resend an earlier PUT verbatim to the key's group's current
         leader. Safe any number of times — the group's dedup registry
         applies it exactly once, surviving failover and restarts."""
+        self.kvs._gate(key)
         g = self.kvs.group_of(key)
         self._group_session(g).retransmit_put(
             self.kvs._leader(g, leader), key, val, req_id)

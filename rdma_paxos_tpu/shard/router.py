@@ -12,8 +12,10 @@ process restarts, machines, and Python versions:
   points on a 32-bit ring, placed by :func:`ring_hash` (FNV-1a mixed
   through the Murmur3 finalizer — never Python's salted ``hash()``)
   over a canonical label; a key routes to the successor point of its
-  own :func:`ring_hash`. Fixed group count — group split/merge
-  reconfiguration is a ROADMAP follow-on, not this layer.
+  own :func:`ring_hash`. The group COUNT stays fixed (G is baked into
+  the compiled dispatch); elastic split/merge (``topology/``)
+  reshapes routing by installing/removing override rules through the
+  mutation surface below, bumping ``version`` at each cutover.
 * an explicit **range-override table**: ordered ``(lo, hi, group)``
   rules on raw key bytes (``lo <= key < hi``, lexicographic;
   ``hi=None`` = unbounded). First matching rule wins and overrides
@@ -106,12 +108,27 @@ class RangeRule:
                    bytes.fromhex(d["hi"]) if d["hi"] is not None else None,
                    d["group"])
 
+    def __eq__(self, other) -> bool:
+        return (isinstance(other, RangeRule) and self.lo == other.lo
+                and self.hi == other.hi and self.group == other.group)
+
+    def __hash__(self) -> int:
+        return hash((self.lo, self.hi, self.group))
+
+    def __repr__(self) -> str:
+        return f"RangeRule({self.lo!r}, {self.hi!r}, {self.group})"
+
 
 class KeyRouter:
     """Hash-ring + range-override key→group mapping (see module doc).
 
-    Deterministic and stateless after construction: ``group_of`` is a
-    pure function of (key, n_groups, vnodes, overrides).
+    Deterministic: ``group_of`` is a pure function of (key, n_groups,
+    vnodes, overrides). The override table is the ONE mutable part —
+    ``install_rule``/``remove_rule`` swap the whole list atomically
+    (one reference assignment; concurrent ``group_of`` readers see
+    the old table or the new, never a partial edit) and bump
+    ``version``, the monotone counter topology cutovers fence txn
+    admissions and serialized snapshots against.
     """
 
     def __init__(self, n_groups: int, *, vnodes: int = 64,
@@ -122,6 +139,7 @@ class KeyRouter:
             raise ValueError("vnodes must be >= 1")
         self.n_groups = int(n_groups)
         self.vnodes = int(vnodes)
+        self.version = 0
         self.overrides: List[RangeRule] = [
             r if isinstance(r, RangeRule) else RangeRule(*r)
             for r in overrides]
@@ -157,6 +175,53 @@ class KeyRouter:
             i = 0                           # wrap to the ring start
         return self._ring[i][1]
 
+    # ---------------- mutation (topology transitions) ----------------
+
+    def _coerce(self, rule: Union[RangeRule, tuple]) -> RangeRule:
+        r = rule if isinstance(rule, RangeRule) else RangeRule(*rule)
+        if not (0 <= r.group < self.n_groups):
+            raise ValueError(
+                f"override group {r.group} out of range "
+                f"[0, {self.n_groups})")
+        return r
+
+    def with_rule(self, rule: Union[RangeRule, tuple]) -> "KeyRouter":
+        """CANDIDATE router: this one plus ``rule`` PREPENDED (first
+        match wins, so the new rule beats any older overlapping rule
+        — same precedence ``install_rule`` later gives it). The
+        transition window routes donor/target decisions by diffing
+        this candidate against the live router; nothing serves it."""
+        r = self._coerce(rule)
+        return KeyRouter(self.n_groups, vnodes=self.vnodes,
+                         overrides=[r] + list(self.overrides))
+
+    def without_rule(self, rule: Union[RangeRule, tuple]) -> "KeyRouter":
+        """CANDIDATE router with the first override equal to ``rule``
+        dropped — the merge direction of :meth:`with_rule`."""
+        r = self._coerce(rule)
+        rest = list(self.overrides)
+        rest.remove(r)             # ValueError if absent — caller bug
+        return KeyRouter(self.n_groups, vnodes=self.vnodes,
+                         overrides=rest)
+
+    def install_rule(self, rule: Union[RangeRule, tuple]) -> int:
+        """Cutover: prepend ``rule`` to the live table (atomic list
+        swap) and bump ``version``. Returns the new version."""
+        r = self._coerce(rule)
+        self.overrides = [r] + list(self.overrides)
+        self.version += 1
+        return self.version
+
+    def remove_rule(self, rule: Union[RangeRule, tuple]) -> int:
+        """Cutover (merge direction): drop the first override equal to
+        ``rule`` (atomic list swap) and bump ``version``."""
+        r = self._coerce(rule)
+        rest = list(self.overrides)
+        rest.remove(r)             # ValueError if absent — caller bug
+        self.overrides = rest
+        self.version += 1
+        return self.version
+
     # ---------------- serialization (health snapshots) ----------------
 
     def to_dict(self) -> dict:
@@ -170,7 +235,7 @@ class KeyRouter:
                 ck = ((ck ^ b) * _FNV_PRIME) & 0xFFFFFFFF
         return dict(schema=1, kind="hash_ring", n_groups=self.n_groups,
                     vnodes=self.vnodes, hash="fnv1a32+fmix32",
-                    ring_checksum=ck,
+                    ring_checksum=ck, version=self.version,
                     overrides=[r.to_dict() for r in self.overrides])
 
     @classmethod
@@ -187,6 +252,8 @@ class KeyRouter:
             raise ValueError(
                 f"router ring checksum mismatch: snapshot {want} != "
                 f"rebuilt {have} (incompatible router versions?)")
+        # pre-elastic snapshots carry no version — reconstruct as 0
+        router.version = int(d.get("version", 0))
         return router
 
     def __repr__(self) -> str:

@@ -39,6 +39,7 @@ import collections
 import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from rdma_paxos_tpu.topology import epoch as _epoch
 from rdma_paxos_tpu.txn import merge as _merge
 from rdma_paxos_tpu.txn import records as _records
 from rdma_paxos_tpu.txn.lane import TXN_CONFLICT, TXN_PREPARED
@@ -87,6 +88,14 @@ class Txn:
         # retries exactly-once), surviving leader failover
         self.record_retry: Dict[Tuple[int, int], int] = {}
         self.reads: Dict[bytes, Optional[bytes]] = {}
+        # routing snapshot at admission: the router version the
+        # key→group mapping was computed under, and every (group, key)
+        # placement it produced — an elastic cutover bumps the version
+        # and the coordinator aborts any undecided txn whose placement
+        # moved (reason ``topology``) rather than lock/commit against
+        # a group the new routing never serves
+        self.router_version = 0
+        self.admitted: List[Tuple[int, bytes]] = []
 
     @property
     def groups(self) -> Sequence[int]:
@@ -145,8 +154,9 @@ class TxnCoordinator:
         # per-group stamped-request counter  # guarded-by: _lock [writes]
         self._req = [0] * self.G
         # per-group term each leader was last seen under (deposition
-        # detection)  # guarded-by: _lock [writes]
-        self._seen_term = [0] * self.G
+        # detection — the shared epoch machinery, one copy for txn AND
+        # topology)  # guarded-by: _lock [writes]
+        self._terms = _epoch.TermWatch(self.G)
         self._next_tid = 1                  # guarded-by: _lock [writes]
         self._lock = threading.RLock()
         from rdma_paxos_tpu.analysis import runtime_guard
@@ -161,6 +171,17 @@ class TxnCoordinator:
         keys to fetch at the serialization point. Lock conflicts abort
         immediately (reason ``conflict``). Mergeable-only write sets
         take the fast path; otherwise the txn joins the 2PC lane."""
+        topo = getattr(self.cluster, "topology", None)
+        if topo is not None:
+            # freeze gate (OUTSIDE the coordinator lock — it blocks):
+            # keys in a migrating range queue here until the cutover
+            # unfreezes them, so no txn admits against a mapping that
+            # is about to flip. The router-version stamp below is the
+            # backstop for the freeze starting after this gate passes.
+            for _op, key, _val in writes:
+                topo.gate_key(key)
+            for key in reads:
+                topo.gate_key(key)
         by_group: Dict[int, list] = {}
         for op, key, val in writes:
             by_group.setdefault(self.kvs.group_of(key), []).append(
@@ -192,6 +213,8 @@ class TxnCoordinator:
                 txn.reason = "conflict"
                 self._count_abort("conflict")
                 return txn
+            txn.router_version = getattr(self.kvs.router, "version", 0)
+            txn.admitted = locked
             self._txns[tid] = txn
             if fast:
                 self._submit_merge(txn)
@@ -251,7 +274,7 @@ class TxnCoordinator:
                 self._submit_record(
                     txn, g, _records.encode_prepare(txn.tid, op, key,
                                                     val))
-            self._seen_term[g] = 0      # set at first prepare append
+            self._terms.reset(g)        # set at first prepare append
 
     # holds-lock: _lock
     def _submit_merge(self, txn: Txn) -> None:
@@ -274,7 +297,8 @@ class TxnCoordinator:
         mask = txn.participant_mask()
         reason = {"conflict": _records.ABORT_CONFLICT,
                   "timeout": _records.ABORT_TIMEOUT,
-                  "failover": _records.ABORT_FAILOVER}.get(
+                  "failover": _records.ABORT_FAILOVER,
+                  "topology": _records.ABORT_TOPOLOGY}.get(
                       txn.reason or "", 0)
         for g in txn.groups:
             payload = (_records.encode_commit(txn.tid, mask) if commit
@@ -317,7 +341,7 @@ class TxnCoordinator:
                     del self._outstanding[(g, req)]
                 elif txn.state == PREPARING:
                     txn.prep_appended[g] += 1
-                    self._seen_term[g] = max(self._seen_term[g], term)
+                    self._terms.note(g, term)
                     del self._outstanding[(g, req)]
                     if (txn.prep_appended[g]
                             == len(txn.writes_by_group[g])):
@@ -336,9 +360,22 @@ class TxnCoordinator:
         with self._lock:
             if not self._txns:
                 return
-            commit_abs = self._commit_abs(res)
+            commit_abs = _epoch.commit_frontier(
+                res, self.cluster.rebased_total)
             votes = res.get("txn_vote")
+            rv = getattr(self.kvs.router, "version", 0)
             for txn in list(self._txns.values()):
+                if (txn.state == PREPARING
+                        and rv != txn.router_version
+                        and any(self.kvs.group_of(k) != g
+                                for g, k in txn.admitted)):
+                    # an elastic cutover moved a participant key's
+                    # group mid-flight: its staged prepares sit in a
+                    # group the new routing never serves — abort
+                    # deterministically (backstop; the freeze gate and
+                    # the cutover's wants_serial() give-way make this
+                    # rare)
+                    self._abort(txn, "topology")
                 if txn.state == PREPARING:
                     self._observe_preparing(txn, res, votes,
                                             commit_abs)
@@ -354,32 +391,20 @@ class TxnCoordinator:
                         self._abort(txn, "timeout")
 
     # holds-lock: _lock
-    def _commit_abs(self, res) -> List[int]:
-        """Per-group ABSOLUTE commit frontier (max over replicas —
-        commit indices are quorum facts, any replica's is valid)."""
-        import numpy as np
-        commit = np.asarray(res["commit"])
-        reb = self.cluster.rebased_total
-        return [int(commit[g].max()) + int(reb[g])
-                for g in range(self.G)]
-
-    # holds-lock: _lock
     def _observe_preparing(self, txn: Txn, res, votes,
                            commit_abs) -> None:
         # deposition: a participant's leader advanced past the term
         # its prepares were appended under — the prepare may be
         # overwritten; abort deterministically (the vote lane's
         # CONFLICT is the committed-overwrite backstop)
-        import numpy as np
-        term_now = np.asarray(res["term"])
+        term_now = _epoch.term_now(res)
         for g in txn.prep_appended:
             if g in txn.prepared:
                 # PREPARED is a quorum fact (committed under the
                 # watched term) — a later term change cannot revoke
                 # it, so a failover here must not abort the txn
                 continue
-            seen = self._seen_term[g]
-            if seen and int(term_now[g].max()) > seen:
+            if self._terms.deposed(g, term_now[g]):
                 self._abort(txn, "failover")
                 return
         for g, (idx, wterm) in list(txn.watch.items()):
@@ -392,8 +417,9 @@ class TxnCoordinator:
                 # unchanged, nothing can have overwritten it — the
                 # common case resolves without waiting a dispatch for
                 # the vote lane (⟹ cross-group commit ≈ 2 dispatches)
-                if (idx < commit_abs[g]
-                        and int(term_now[g].max()) == wterm):
+                if (_epoch.placement_status(idx, wterm, commit_abs[g],
+                                            term_now[g])
+                        == _epoch.COMPLETE):
                     txn.prepared.add(g)
                     self.cluster.clear_txn_watch(g)
                     continue
@@ -443,31 +469,28 @@ class TxnCoordinator:
             return False, None
         return True, kv.serve_local(r, key)
 
-    # retry patience (steps) before a decided record not yet appended
-    # is resubmitted — covers a deposed/mis-hinted leader that dropped
-    # the submission (dedup keeps every retry exactly-once)
-    RETRY_STEPS = 4
+    # retry patience before a decided record not yet appended is
+    # resubmitted (shared epoch constant — topology seeding uses the
+    # same patience for ITS stamped records)
+    RETRY_STEPS = _epoch.RETRY_STEPS
 
     # holds-lock: _lock
     def _observe_decided(self, txn: Txn, res, commit_abs) -> None:
-        import numpy as np
-        term_now = np.asarray(res["term"])
+        term_now = _epoch.term_now(res)
         for (g, req), idx in list(txn.record_index.items()):
-            if idx >= 0:
-                wterm = txn.record_term.get((g, req), 0)
-                if idx < commit_abs[g] and int(term_now[g].max()) == wterm:
-                    del txn.record_index[(g, req)]
-                    txn.record_term.pop((g, req), None)
-                    txn.record_payload.pop((g, req), None)
-                    txn.record_retry.pop((g, req), None)
-                elif int(term_now[g].max()) > wterm:
-                    # the append may sit on a deposed leader's
-                    # overwritten suffix — a later commit frontier
-                    # past its index proves nothing. Forget the
-                    # placement and retry under the SAME stamp: if it
-                    # DID commit, dedup makes the retry a no-op.
-                    txn.record_index[(g, req)] = -1
-                    txn.record_retry[(g, req)] = self.cluster.step_index
+            st = _epoch.placement_status(
+                idx, txn.record_term.get((g, req), 0), commit_abs[g],
+                term_now[g])
+            if st == _epoch.COMPLETE:
+                del txn.record_index[(g, req)]
+                txn.record_term.pop((g, req), None)
+                txn.record_payload.pop((g, req), None)
+                txn.record_retry.pop((g, req), None)
+            elif st == _epoch.INVALIDATED:
+                # forget the placement and retry under the SAME stamp:
+                # if it DID commit, dedup makes the retry a no-op
+                txn.record_index[(g, req)] = -1
+                txn.record_retry[(g, req)] = self.cluster.step_index
             elif idx < 0:
                 lead = self.cluster.leader_hint(g)
                 if (lead >= 0 and self.cluster.step_index

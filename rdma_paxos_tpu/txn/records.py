@@ -40,8 +40,13 @@ from rdma_paxos_tpu.models.kvs import CMD_W, encode_cmd
 TXN_PREPARE, TXN_COMMIT, TXN_ABORT, TXN_MERGE = 1, 2, 3, 4
 TXN_CMD_W = 3 + CMD_W
 
-# ABORT-record reason codes (mirrors the txn_aborted_total labels)
-ABORT_CONFLICT, ABORT_TIMEOUT, ABORT_FAILOVER = 1, 2, 3
+# ABORT-record reason codes (mirrors the txn_aborted_total labels).
+# TOPOLOGY: the key→group mapping of a participant key moved while the
+# transaction was in flight (an elastic split/merge cutover bumped the
+# router epoch) — locking or committing against the stale group would
+# write state the new routing never serves, so the coordinator aborts
+# deterministically instead.
+ABORT_CONFLICT, ABORT_TIMEOUT, ABORT_FAILOVER, ABORT_TOPOLOGY = 1, 2, 3, 4
 
 
 def encode_prepare(tid: int, op: int, key: bytes,

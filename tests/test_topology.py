@@ -1,0 +1,529 @@
+"""topology/ — elastic group split/merge: acceptance properties.
+
+* ONE shared epoch abstraction: the term-watch/completion-proof
+  machinery lives in ``topology/epoch.py`` and the txn coordinator
+  imports it — no second copy of the rules anywhere;
+* the router mutation surface (``install_rule``/``remove_rule`` +
+  monotone ``version``) round-trips through serialization, through
+  ``health()``, and through the fleet console; the golden router map
+  gains a post-split fixture and checksum tampering is still refused;
+* a split moves a live key range to its new owner group with values
+  intact, a merge returns it, and the trace ring proves leases on
+  every affected group were revoked BEFORE the cutover and re-granted
+  after — with the cluster stepping the whole time;
+* topology is a zero-device-change subsystem: STEP_CACHE keys and
+  step outputs are bit-identical with a controller attached, even
+  after a full split/merge cycle (splits reshape host routing only);
+* an in-flight 2PC transaction whose key→group mapping moved aborts
+  deterministically with the dedicated TOPOLOGY reason;
+* the load policy proposes with hysteresis (AlertEngine ``for_evals``),
+  sits out its own cooldown, respects the governor's shed veto, and
+  never merges operator-pinned override rules;
+* the seeded split-mid-nemesis chaos schedule is green and
+  deterministic (same seed ⟹ byte-identical verdict).
+"""
+
+import json
+import pathlib
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from benchmarks.arrival_traces import zipf_keys
+from rdma_paxos_tpu.config import LogConfig
+from rdma_paxos_tpu.obs import AlertEngine, Observability
+from rdma_paxos_tpu.obs import trace as obs_trace
+from rdma_paxos_tpu.obs.console import _topo_state
+from rdma_paxos_tpu.runtime import reads as reads_mod
+from rdma_paxos_tpu.runtime.sim import STEP_CACHE
+from rdma_paxos_tpu.shard import ShardedCluster
+from rdma_paxos_tpu.shard.kvs import ShardedKVS
+from rdma_paxos_tpu.shard.router import KeyRouter, RangeRule
+from rdma_paxos_tpu.topology import attach_topology
+from rdma_paxos_tpu.topology import epoch as epoch_mod
+from rdma_paxos_tpu.topology.policy import (
+    MERGE_RULE, SPLIT_RULE, TopologyPolicy)
+from rdma_paxos_tpu.txn import attach_coordinator
+from rdma_paxos_tpu.txn.chaos import keys_for_groups
+
+# a geometry no other test uses: the cache-key guard below reasons
+# about which keys THIS test file's clusters add to the shared cache
+CFG = LogConfig(n_slots=256, slot_bytes=128, window_slots=32,
+                batch_slots=8)
+
+GOLDEN = pathlib.Path(__file__).parent / "golden" / "router_map.json"
+
+
+def _cluster(G=2, *, cfg=CFG, txn=False, **opts):
+    """Direct-stepped sharded cluster with obs + leases + topology."""
+    shard = ShardedCluster(cfg, 3, G, txn=txn)
+    obs = Observability()
+    shard.obs = obs
+    kv = ShardedKVS(shard, cap=256)
+    reads_mod.attach(shard)
+    opts.setdefault("cooldown_steps", 4)
+    ctl = attach_topology(kv, obs=obs, **opts)
+    shard.place_leaders()
+    return shard, kv, ctl, obs
+
+
+def _run_window(shard, ctl, max_steps=300):
+    """Step + drive until the transition window closes."""
+    for _ in range(max_steps):
+        shard.step()
+        ctl.drive()
+        if not ctl.in_window():
+            return
+    raise AssertionError("transition window did not close: "
+                         f"{ctl.status()}")
+
+
+def _seed_keys(shard, kv, per_group=6):
+    """Write a known value under ``per_group`` keys per group; ->
+    ``keys[g]`` lists (committed before return)."""
+    keys = keys_for_groups(kv.router, per_group)
+    for g, ks in enumerate(keys):
+        for k in ks:
+            kv.put(k, b"v0:" + k, leader=shard.leader_hint(g))
+    for _ in range(4):
+        shard.step()
+    return keys
+
+
+# ---------------------------------------------------------------------------
+# the shared epoch abstraction (one copy, two users)
+# ---------------------------------------------------------------------------
+
+def test_epoch_machinery_is_shared_not_copied():
+    """The txn coordinator and the transition window must consume the
+    SAME module object — the factored-out machinery, not a fork."""
+    from rdma_paxos_tpu.topology import transition as transition_mod
+    from rdma_paxos_tpu.txn import coordinator as txn_coord
+    assert txn_coord._epoch is epoch_mod
+    assert transition_mod._epoch is epoch_mod
+    # the coordinator keeps no private copies of the factored helpers
+    src = pathlib.Path(txn_coord.__file__).read_text()
+    for dup in ("def commit_frontier", "def placement_status",
+                "class TermWatch", "def term_now"):
+        assert dup not in src, f"coordinator re-grew {dup!r}"
+
+
+def test_epoch_placement_status_rules():
+    P, C, I = epoch_mod.PENDING, epoch_mod.COMPLETE, epoch_mod.INVALIDATED
+    # unplaced: pending regardless of frontiers
+    assert epoch_mod.placement_status(-1, 0, 100, 9) == P
+    # committed under an unchanged term: durable
+    assert epoch_mod.placement_status(5, 3, 6, 3) == C
+    # term advanced: the frontier proves nothing — forget and retry
+    assert epoch_mod.placement_status(5, 3, 6, 4) == I
+    assert epoch_mod.placement_status(5, 3, 4, 4) == I
+    # not yet committed, term unchanged: keep waiting
+    assert epoch_mod.placement_status(5, 3, 5, 3) == P
+
+
+def test_epoch_term_watch_and_clock():
+    w = epoch_mod.TermWatch(2)
+    assert not w.deposed(0, 5)          # nothing appended: never deposed
+    w.note(0, 3)
+    assert not w.deposed(0, 3) and w.deposed(0, 4)
+    w.reset(0)
+    assert not w.deposed(0, 9)
+    clk = epoch_mod.EpochClock(2)
+    assert clk.current() == 2 and clk.bump() == 3 and clk.current() == 3
+
+
+# ---------------------------------------------------------------------------
+# router mutation surface + serialization
+# ---------------------------------------------------------------------------
+
+def test_router_mutation_versions_and_candidate_purity():
+    r = KeyRouter(4)
+    assert r.version == 0
+    rule = RangeRule(b"m", b"n", 3)
+    cand = r.with_rule(rule)
+    # candidates are PURE: the live router is untouched
+    assert r.version == 0 and not r.overrides
+    assert cand.group_of(b"mid") == 3
+    assert r.install_rule(rule) == 1 and r.version == 1
+    assert r.group_of(b"mid") == 3
+    back = r.without_rule(rule)
+    assert back.group_of(b"mid") == KeyRouter(4).group_of(b"mid")
+    assert r.remove_rule(rule) == 2 and r.version == 2
+    assert r.group_of(b"mid") == KeyRouter(4).group_of(b"mid")
+
+
+def test_router_serialization_carries_version_and_refuses_tamper():
+    r = KeyRouter(4)
+    r.install_rule(RangeRule(b"user:", b"user;", 2))
+    d = r.to_dict()
+    assert d["version"] == 1
+    r2 = KeyRouter.from_dict(d)
+    assert r2.version == 1 and r2.overrides == r.overrides
+    for k in (b"", b"user:42", b"key7", "ключ"):
+        assert r2.group_of(k) == r.group_of(k)
+    # checksum tamper still refused with overrides + version present
+    with pytest.raises(ValueError, match="checksum mismatch"):
+        KeyRouter.from_dict(dict(d, ring_checksum=d["ring_checksum"] ^ 1))
+    # pre-elastic snapshots (no version field) reconstruct as 0
+    legacy = {k: v for k, v in d.items() if k != "version"}
+    assert KeyRouter.from_dict(legacy).version == 0
+
+
+def test_router_golden_map_and_post_split_fixture():
+    doc = json.loads(GOLDEN.read_text())
+    base = KeyRouter.from_dict(doc["router"])
+    for key, g in doc["mapping"].items():
+        assert base.group_of(key) == g, key
+    ps = doc["post_split"]
+    rule = RangeRule.from_dict(ps["rule"])
+    # installing the pinned split rule reproduces the pinned post-split
+    # table exactly (version, override order, checksum — everything)
+    live = KeyRouter.from_dict(doc["router"])
+    live.install_rule(rule)
+    assert live.to_dict() == ps["router"]
+    # and the post-split serialized form round-trips on its own
+    after = KeyRouter.from_dict(ps["router"])
+    assert after.version == ps["router"]["version"] == 1
+    moved = 0
+    for key, g in ps["mapping"].items():
+        assert after.group_of(key) == g, key
+        moved += int(base.group_of(key) != g)
+    assert moved >= 3, "fixture must pin keys the split actually moved"
+    with pytest.raises(ValueError, match="checksum mismatch"):
+        KeyRouter.from_dict(dict(
+            ps["router"],
+            ring_checksum=ps["router"]["ring_checksum"] ^ 1))
+
+
+# ---------------------------------------------------------------------------
+# split / merge end-to-end (live cluster, lease fence proven)
+# ---------------------------------------------------------------------------
+
+def test_split_then_merge_moves_range_and_fences_leases():
+    shard, kv, ctl, obs = _cluster(G=2)
+    keys = _seed_keys(shard, kv)
+    hot = sorted(keys[0])
+    lo, hi = hot[len(hot) // 2], hot[-1] + b"\x00"
+    moving = [k for k in hot if lo <= k < hi]
+    assert moving
+    assert ctl.propose_split(lo, hi, 1)
+    assert not ctl.propose_split(lo, hi, 1), "window already open"
+    _run_window(shard, ctl)
+
+    st = ctl.status()
+    assert st["phase"] == "idle" and st["frozen"] is False
+    # straight out of the window: cooling, so a new proposal is refused
+    rule = RangeRule(lo, hi, 1)
+    assert ctl.cooling() and not ctl.propose_merge(rule)
+    assert st["transitions_total"] == 1 and st["abandoned_total"] == 0
+    assert st["epoch"] == 1 and kv.router.version == 1
+    assert RangeRule(lo, hi, 1) in kv.router.overrides
+    for k in moving:          # values survived the move, routing moved
+        assert kv.group_of(k) == 1
+        assert kv.get(k) == b"v0:" + k
+    for k in hot:             # below the median: still the old owner
+        if k < lo:
+            assert kv.group_of(k) == 0
+    # a post-split write routes to (and lands in) the new owner
+    kv.put(moving[0], b"v1", leader=shard.leader_hint(1))
+    for _ in range(4):
+        shard.step()
+    assert kv.get(moving[0]) == b"v1"
+    assert dict(kv.groups[1].items_in_range(
+        shard.leader_hint(1), lo, hi))[moving[0]] == b"v1"
+
+    # merge = the same window in reverse, after the cooldown
+    while ctl.cooling():
+        shard.step()
+    assert ctl.propose_merge(rule)
+    _run_window(shard, ctl)
+    assert not kv.router.overrides and kv.router.version == 2
+    assert ctl.status()["epoch"] == 2
+    assert ctl.transitions_total == 2 and ctl.abandoned_total == 0
+    for k in moving:
+        assert kv.group_of(k) == 0
+    assert kv.get(moving[0]) == b"v1"       # the post-split write moved back
+    for k in moving[1:]:
+        assert kv.get(k) == b"v0:" + k
+
+    # lease fence, from the trace ring: every affected group's lease
+    # was revoked BEFORE each cutover and granted again after the last
+    ev = obs.trace.events()
+    cuts = [e for e in ev if e.kind == obs_trace.TOPOLOGY_CUTOVER]
+    assert len(cuts) == 2
+    for cut in cuts:
+        affected = set(cut.fields.get("donors", ())) | set(
+            cut.fields.get("targets", ()))
+        assert affected
+        for g in affected:
+            assert any(e.kind == obs_trace.LEASE_REVOKED
+                       and e.fields.get("reason") == "topology_cutover"
+                       and e.fields.get("group") == g
+                       and e.seq < cut.seq for e in ev), (g, cut)
+    for _ in range(8):        # lease re-grant is step-driven (guard
+        shard.step()          # steps first), so step past the barrier
+    kv.get(moving[0], linearizable=True)
+    ev = obs.trace.events()
+    last_cut = max(e.seq for e in ev
+                   if e.kind == obs_trace.TOPOLOGY_CUTOVER)
+    assert any(e.kind == obs_trace.LEASE_GRANTED and e.seq > last_cut
+               for e in ev), "leases must re-grant after the cutover"
+
+
+def test_proposal_refusals_and_would_block_gate():
+    shard, kv, ctl, obs = _cluster(G=2)
+    with pytest.raises(ValueError, match="rule not installed"):
+        ctl.propose_merge(RangeRule(b"a", b"b", 1))
+    assert not ctl.would_block(b"anything")     # idle: gate wide open
+    assert not ctl.in_window() and not ctl.frozen()
+
+
+# ---------------------------------------------------------------------------
+# health / console round-trip
+# ---------------------------------------------------------------------------
+
+def test_health_router_roundtrip_and_console_after_split():
+    from rdma_paxos_tpu.obs import console as console_mod
+    shard, kv, ctl, obs = _cluster(G=2)
+    keys = _seed_keys(shard, kv)
+    hot = sorted(keys[0])
+    assert ctl.propose_split(hot[len(hot) // 2], hot[-1] + b"\x00", 1)
+    _run_window(shard, ctl)
+
+    h = shard.health()
+    # the override table round-trips through the health document:
+    # an observer rebuilds the EXACT post-split mapping without code
+    rebuilt = KeyRouter.from_dict(h["router"])
+    assert rebuilt.version == 1 and len(rebuilt.overrides) == 1
+    for ks in keys:
+        for k in ks:
+            assert rebuilt.group_of(k) == kv.group_of(k)
+    topo = h["topology"]
+    assert topo["transitions_total"] == 1 and topo["epoch"] == 1
+    assert topo["phase"] == "idle"
+
+    # console column: direct renderer + the fleet table
+    assert _topo_state(h) == "e1/1t"
+    assert _topo_state(dict()) == "-"
+    assert _topo_state(dict(topology=dict(
+        epoch=0, transitions_total=0, phase="seed",
+        direction="split"))) == "e0/0t split:seed"
+    h["ts"] = 1.0
+    view = console_mod.fleet_view([dict(src="local", health=h)])
+    assert [r["topo"] for r in view["groups"]] == ["e1/1t", "-"]
+    out = console_mod.render_table(view)
+    assert "TOPO" in out and "e1/1t" in out
+
+
+# ---------------------------------------------------------------------------
+# zero device changes (the audit=/telemetry=/txn= discipline)
+# ---------------------------------------------------------------------------
+
+def test_topology_adds_no_step_cache_keys_and_outputs_identical():
+    # fresh geometry: no other test has populated the cache for it,
+    # so "adds nothing" is an exact set comparison
+    cfg = LogConfig(n_slots=64, slot_bytes=128, window_slots=8,
+                    batch_slots=4)
+
+    def workload(shard, kv):
+        shard.place_leaders()
+        keys = keys_for_groups(kv.router, 4)
+        for t in range(3):
+            for g, ks in enumerate(keys):
+                kv.put(ks[t], b"w%d" % t, leader=shard.leader_hint(g))
+            shard.step()
+        shard.step()
+        return keys
+
+    plain = ShardedCluster(cfg, 3, 2)
+    kv_p = ShardedKVS(plain, cap=64)
+    workload(plain, kv_p)
+    keys_before = set(STEP_CACHE)
+
+    topo = ShardedCluster(cfg, 3, 2)
+    kv_t = ShardedKVS(topo, cap=64)
+    ctl = attach_topology(kv_t, cooldown_steps=2)
+    keys_t = workload(topo, kv_t)
+    assert set(STEP_CACHE) == keys_before, (
+        "attaching topology must add NOTHING to the step cache")
+    for k in ("term", "commit", "end", "apply", "head", "role"):
+        assert np.array_equal(np.asarray(plain.last[k]),
+                              np.asarray(topo.last[k])), k
+
+    # even a FULL split/merge cycle compiles nothing new: seeding is
+    # ordinary stamped client records through the existing programs
+    hot = sorted(keys_t[0])
+    assert ctl.propose_split(hot[len(hot) // 2], hot[-1] + b"\x00", 1)
+    _run_window(topo, ctl)
+    assert ctl.transitions_total == 1
+    assert set(STEP_CACHE) == keys_before, (
+        "a transition window must add NOTHING to the step cache")
+
+
+# ---------------------------------------------------------------------------
+# txn integration: the deterministic TOPOLOGY abort
+# ---------------------------------------------------------------------------
+
+def test_inflight_txn_aborts_when_mapping_moves():
+    shard, kv, ctl, obs = _cluster(G=2, txn=True)
+    coord = attach_coordinator(kv)
+    keys = keys_for_groups(kv.router, 4)
+    # warm the lane, then open a 2PC txn and move a participant's key
+    # range out from under it BEFORE it can decide
+    h = kv.transact([("put", keys[0][3], b"w"),
+                     ("put", keys[1][3], b"w")])
+    for _ in range(6):
+        if h.done:
+            break
+        shard.step()
+    assert h.committed
+
+    ka, kb = keys[0][0], keys[1][0]
+    h = kv.transact([("put", ka, b"A"), ("put", kb, b"B")])
+    kv.router.install_rule(RangeRule(ka, ka + b"\x00", 1))
+    for _ in range(8):
+        if h.done:
+            break
+        shard.step()
+    assert h.done and not h.committed
+    assert h.abort_reason == "topology"
+    # no partial writes anywhere, and the dedicated counter ticked
+    shard.step()
+    assert kv.get(ka) is None and kv.get(kb) is None
+    m = shard.obs.metrics.snapshot()["counters"]
+    assert m.get("txn_aborted_total{reason=topology}") == 1
+
+
+# ---------------------------------------------------------------------------
+# the load-driven policy loop
+# ---------------------------------------------------------------------------
+
+def test_policy_stock_rules_fire_on_transition_with_hysteresis():
+    obs = Observability()
+    pol = TopologyPolicy(skew_ratio=2.0, cold_ratio=0.5, for_evals=3)
+    engine = AlertEngine(obs.metrics, rules=pol.stock_rules())
+    fired = []
+    engine.add_hook(lambda name, sev: fired.append(name))
+    obs.metrics.set("topology_skew", 3.0)
+    obs.metrics.set("topology_override_load", 4.0)   # never cold
+    engine.evaluate()
+    engine.evaluate()
+    assert fired == [], "hysteresis: a 2-eval spike must not fire"
+    engine.evaluate()
+    assert fired == [SPLIT_RULE]
+    engine.evaluate()
+    assert fired == [SPLIT_RULE], "firing->firing is not a transition"
+    # resolve, then re-cross: fires again
+    obs.metrics.set("topology_skew", 1.0)
+    engine.evaluate()
+    obs.metrics.set("topology_skew", 3.0)
+    for _ in range(3):
+        engine.evaluate()
+    assert fired == [SPLIT_RULE, SPLIT_RULE]
+    # the cold side fires the merge rule the same way
+    obs.metrics.set("topology_override_load", 0.2)
+    for _ in range(3):
+        engine.evaluate()
+    assert fired[-1] == MERGE_RULE
+
+
+def test_policy_proposes_split_cooldown_and_governor_veto():
+    pol = TopologyPolicy(window=8, skew_ratio=1.5, for_evals=2,
+                         cooldown_evals=6, min_keys=2)
+    shard, kv, ctl, obs = _cluster(G=2, policy=pol)
+    keys = keys_for_groups(kv.router, 6)
+    # skew all the work onto group 0; observe() rides the finish tail,
+    # so plain stepping feeds the policy's trailing window
+    for t in range(10):
+        for k in keys[0]:
+            kv.put(k, b"s%d" % t, leader=shard.leader_hint(0))
+        shard.step()
+    assert pol.status()["shares"][0] > 0.9
+    g = obs.metrics.snapshot()["gauges"]
+    assert g.get("topology_skew") > 1.5
+    assert g.get("topology_group_share{group=0}") > 0.9
+
+    pol.on_alert(SPLIT_RULE, "warn")        # the engine's hook path
+    assert pol.proposals == 1 and ctl.in_window()
+    st = ctl.status()
+    assert st["direction"] == "split" and st["rule"]["group"] == 1
+    _run_window(shard, ctl)
+    assert ctl.transitions_total == 1
+    assert pol.status()["rules"], "policy must track the rule as its own"
+
+    # policy-level cooldown: a refire inside cooldown_evals proposes
+    # nothing even with the controller idle again
+    pol.on_alert(SPLIT_RULE, "warn")
+    assert pol.proposals == 1
+
+    # governor veto: shed latch up ⟹ no proposal, vetoes counted
+    for _ in range(8):                      # walk past the cooldown
+        shard.step()
+    shard.governor = SimpleNamespace(
+        decision=SimpleNamespace(shed=True))
+    pol.on_alert(SPLIT_RULE, "warn")
+    assert pol.proposals == 1 and pol.vetoes == 1
+    shard.governor = None
+
+    # merge only ever touches policy-installed rules: an operator-
+    # pinned override is never proposed for merge
+    mine = pol.status()["rules"]
+    op_rule = RangeRule(b"\x00op", b"\x00oq", 1)
+    kv.router.install_rule(op_rule)
+    with pol._lock:
+        pol._mine = []                      # pretend ours was merged
+    pol.on_alert(MERGE_RULE, "warn")
+    assert not ctl.in_window() and pol.proposals == 1
+    assert mine and mine[0]["group"] == 1
+
+
+def test_policy_median_range_needs_min_keys():
+    pol = TopologyPolicy(min_keys=4)
+    shard, kv, ctl, obs = _cluster(G=2, policy=pol)
+    keys = keys_for_groups(kv.router, 2)
+    for k in keys[0]:
+        kv.put(k, b"x", leader=shard.leader_hint(0))
+    for _ in range(4):
+        shard.step()
+    assert pol._median_range(0) is None     # 2 keys < min_keys
+    pol.on_alert(SPLIT_RULE, "warn")
+    assert pol.proposals == 0 and not ctl.in_window()
+
+
+# ---------------------------------------------------------------------------
+# the Zipf key-shape generator (benchmarks satellite)
+# ---------------------------------------------------------------------------
+
+def test_zipf_keys_deterministic_and_skew_scales_with_s():
+    a = zipf_keys(500, s=1.2, n_keys=16, seed=3)
+    assert a == zipf_keys(500, s=1.2, n_keys=16, seed=3)
+    assert a != zipf_keys(500, s=1.2, n_keys=16, seed=4)
+    assert len(a) == 500 and all(k.startswith(b"key") for k in a)
+
+    def top_share(s):
+        draws = zipf_keys(4000, s=s, n_keys=16, seed=0)
+        counts = sorted((draws.count(k) for k in set(draws)),
+                        reverse=True)
+        return counts[0] / len(draws)
+    assert top_share(2.0) > top_share(0.8) > top_share(0.0)
+    # s=0 is uniform: the hottest key stays near the fair share
+    assert top_share(0.0) < 2.5 / 16
+
+
+# ---------------------------------------------------------------------------
+# chaos: split mid-nemesis, deterministic verdict
+# ---------------------------------------------------------------------------
+
+def test_topology_chaos_split_mid_crash_green_and_deterministic():
+    from rdma_paxos_tpu.topology.chaos import run_topology_chaos
+    v1 = run_topology_chaos(seed=0)
+    assert v1["ok"], v1
+    assert v1["invariant_violations"] == []
+    assert v1["linearizability"]["ok"] and v1["linearizability"]["ops"] > 200
+    assert v1["lease_fence"]["ok"] and v1["lease_fence"]["cutovers"] == 2
+    assert v1["topology"]["transitions"] == 2
+    assert v1["topology"]["abandoned"] == 0
+    assert v1["new_leader"] != v1["crashed_leader"]
+    v2 = run_topology_chaos(seed=0)
+    assert v1 == v2, "same seed must re-derive the identical verdict"
