@@ -63,6 +63,9 @@ def main(argv=None) -> int:
         d.step()
     d.evaluate_alerts()
     d.obs.spans.write_json(os.path.join(wd, "spans.json"))
+    if d.obs.tracectx.counts()["by_kind"]:
+        # subsystem traces exist only when txn/topology/watch ran
+        d.obs.tracectx.write_json(os.path.join(wd, "traces.json"))
     write_audit_artifact(os.path.join(wd, "audit_dump.json"),
                          reason="ci postmortem smoke",
                          ledger=d.cluster.auditor,

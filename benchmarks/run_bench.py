@@ -403,6 +403,49 @@ def measure_export_overhead(cfg=None, *, sample_period_s=0.25,
                                   after_step=after_step, **kw)
 
 
+def measure_trace_overhead(cfg=None, *, sample_every=64, **kw):
+    """A/B the causal-tracing plane end to end (the <2% acceptance
+    target): identical closed-loop workloads where every step ALSO
+    issues one stamped client-session put (the path that begins
+    spans), with span sampling at the production default (ON,
+    ``sample_every`` + a TraceContext attached) vs disabled (OFF,
+    ``sample_every=0`` — the one switch that silences spans AND
+    subsystem traces). Alternating best-of rounds, the shared
+    methodology; the ON row carries the span/trace counts as proof
+    that tracing actually ran."""
+    from rdma_paxos_tpu.models.replicated_kvs import (ClientSession,
+                                                      ReplicatedKVS)
+    from rdma_paxos_tpu.obs import Observability
+    from rdma_paxos_tpu.obs.spans import SpanRecorder
+    from rdma_paxos_tpu.runtime.sim import SimCluster
+
+    sessions = {}
+
+    def make(variant, mcfg, n_replicas):
+        c = SimCluster(mcfg, n_replicas, fanout="psum")
+        c.obs = Observability(span_recorder=SpanRecorder(
+            sample_every=(sample_every if variant == "on" else 0)))
+        c.run_until_elected(0)
+        sessions[id(c)] = ClientSession(ReplicatedKVS(c), client_id=7)
+        return c
+
+    def after_step(variant, c):
+        s = sessions[id(c)]
+        s.put(0, b"tk%03d" % (s.req_id % 512), b"v")
+        # the drivers' ack-release tail (a no-op with sampling off):
+        # retires acked spans so steady-state open_count stays
+        # bounded, exactly as production runs it
+        c.obs.spans.ack_release(0, s.req_id - 1)
+
+    def proof(on_c, out):
+        out["trace"] = dict(sample_every=sample_every,
+                            spans=on_c.obs.spans.counts(),
+                            traces=on_c.obs.tracectx.counts())
+
+    return _measure_flag_overhead("trace", proof, cfg, make=make,
+                                  after_step=after_step, **kw)
+
+
 def measure_repair(cfg=None, *, n_replicas=3, steps=300, per_step=8,
                    payload=64, warmup=10, repeats=3,
                    corrupt_after=40, probation=6, mttr_budget=400):
@@ -1117,6 +1160,12 @@ def main():
                          "device_*{replica=} series during the "
                          "workload, and emit a telemetry_overhead_pct "
                          "A/B row (counters on vs off, target <5%%)")
+    ap.add_argument("--trace-overhead", action="store_true",
+                    help="A/B the causal-tracing plane: span sampling "
+                         "at the production default + TraceContext vs "
+                         "sampling disabled, identical stamped-session "
+                         "workloads — emits a trace_overhead_pct row "
+                         "(target <2%%)")
     ap.add_argument("--profile", action="store_true",
                     help="bounded jax.profiler capture of the client "
                          "wave; writes the raw capture, a "
@@ -1751,6 +1800,19 @@ def main():
              detail=dict(off=ab["off"], on=ab["on"],
                          device_counters=ab["device_counters"],
                          e2e_series=len(snap_counters)),
+             obs=driver.obs, json_path=args.json)
+
+    if args.trace_overhead:
+        # sampling on (production default + TraceContext) vs off, on
+        # the now-quiet process — the tracing counterpart of the
+        # export row above, same <2% end-to-end target
+        ab = measure_trace_overhead()
+        print(f"trace overhead: {ab['off']['ops_per_sec']} ops/s "
+              f"off vs {ab['on']['ops_per_sec']} ops/s on "
+              f"({ab['overhead_pct']}% — target <2%)")
+        emit("trace_overhead_pct", ab["overhead_pct"], "%",
+             detail=dict(off=ab["off"], on=ab["on"],
+                         trace=ab["trace"]),
              obs=driver.obs, json_path=args.json)
 
 

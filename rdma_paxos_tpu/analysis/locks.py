@@ -64,6 +64,7 @@ LOCK_MODULES = (
     "rdma_paxos_tpu/streams/watch.py",
     "rdma_paxos_tpu/topology/transition.py",
     "rdma_paxos_tpu/topology/policy.py",
+    "rdma_paxos_tpu/obs/tracectx.py",
 )
 
 _GUARD_RE = re.compile(

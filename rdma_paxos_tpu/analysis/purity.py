@@ -111,6 +111,12 @@ HOST_PURE_MODULES: Dict[str, dict] = {
     "rdma_paxos_tpu/obs/export.py": dict(
         ban_imports=("jax", "jaxlib"),
         patterns=(r"\bjax\b", r"\bjnp\b", r"shard_map")),
+    # the unified trace plane: cross-subsystem provenance + blame is
+    # pure host bookkeeping — it must never touch the device (step
+    # programs and cache keys are bit-identical with tracing on)
+    "rdma_paxos_tpu/obs/tracectx.py": dict(
+        ban_imports=("jax", "jaxlib"),
+        patterns=(r"\bjax\b", r"\bjnp\b", r"shard_map")),
     "rdma_paxos_tpu/obs/console.py": dict(
         ban_imports=("jax", "jaxlib"),
         patterns=(r"\bjax\b", r"\bjnp\b", r"shard_map")),

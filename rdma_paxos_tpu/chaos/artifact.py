@@ -68,6 +68,14 @@ def write_reproducer(path: Optional[str] = None, *, seed: int,
                if getattr(obs, "spans", None) is not None else None),
         extra=extra or {},
     )
+    tctx = getattr(obs, "tracectx", None)
+    if tctx is not None:
+        traces = tctx.dump()
+        if traces["traces"]:
+            # subsystem traces (txn/topology/watch) ride along only
+            # when some were recorded — trace-free artifacts keep the
+            # schema-1 shape byte-for-byte
+            doc["traces"] = traces
     if path is None:
         fd, path = tempfile.mkstemp(prefix="chaos_repro_",
                                     suffix=".json")

@@ -61,7 +61,7 @@ from typing import Optional
 
 from rdma_paxos_tpu.obs import (
     alerts, audit, clock, device, export, health, metrics, series,
-    spans, trace)
+    spans, trace, tracectx)
 from rdma_paxos_tpu.obs.alerts import AlertEngine
 from rdma_paxos_tpu.obs.audit import AuditLedger, FlightRecorder
 from rdma_paxos_tpu.obs.device import ProfilerSession
@@ -71,6 +71,7 @@ from rdma_paxos_tpu.obs.metrics import MetricsRegistry
 from rdma_paxos_tpu.obs.series import TimeSeriesStore
 from rdma_paxos_tpu.obs.spans import SpanRecorder, StepPhaseProfiler
 from rdma_paxos_tpu.obs.trace import TraceRing
+from rdma_paxos_tpu.obs.tracectx import TraceContext
 
 
 class Observability:
@@ -82,27 +83,37 @@ class Observability:
 
     def __init__(self, metrics_registry: Optional[MetricsRegistry] = None,
                  trace_ring: Optional[TraceRing] = None,
-                 span_recorder: Optional[SpanRecorder] = None):
+                 span_recorder: Optional[SpanRecorder] = None,
+                 trace_context: Optional[TraceContext] = None):
         self.metrics = (metrics_registry if metrics_registry is not None
                         else MetricsRegistry())
         self.trace = (trace_ring if trace_ring is not None
                       else TraceRing())
         self.spans = (span_recorder if span_recorder is not None
                       else SpanRecorder())
+        self.tracectx = (trace_context if trace_context is not None
+                         else TraceContext())
 
     def snapshot(self) -> dict:
         """Combined point-in-time export: the metrics snapshot plus the
         trace ring's retained events plus the span dump — every part
-        stamped with the shared clock anchor."""
-        return {"anchor": clock.anchor(),
-                "metrics": self.metrics.snapshot(),
-                "trace": self.trace.dump(),
-                "spans": self.spans.dump()}
+        stamped with the shared clock anchor. Subsystem traces ride as
+        ``traces`` only when some exist, so trace-free snapshots keep
+        the pre-trace-plane schema byte-for-byte."""
+        out = {"anchor": clock.anchor(),
+               "metrics": self.metrics.snapshot(),
+               "trace": self.trace.dump(),
+               "spans": self.spans.dump()}
+        traces = self.tracectx.dump()
+        if traces["traces"]:
+            out["traces"] = traces
+        return out
 
     def reset(self) -> None:
         self.metrics.reset()
         self.trace.clear()
         self.spans.reset()
+        self.tracectx.reset()
 
 
 _default: Optional[Observability] = None
@@ -122,5 +133,6 @@ __all__ = ["Observability", "MetricsRegistry", "TraceRing",
            "HealthReporter", "SpanRecorder", "StepPhaseProfiler",
            "AuditLedger", "FlightRecorder", "AlertEngine",
            "ProfilerSession", "TimeSeriesStore", "OpsExporter",
-           "default", "metrics", "trace", "health", "spans", "clock",
-           "audit", "alerts", "device", "series", "export"]
+           "TraceContext", "default", "metrics", "trace", "health",
+           "spans", "clock", "audit", "alerts", "device", "series",
+           "export", "tracectx"]

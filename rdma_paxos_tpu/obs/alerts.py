@@ -418,6 +418,27 @@ class AlertEngine:
                        / total)
         return bad_frac / max(1e-12, 1.0 - float(rule["objective"]))
 
+    @staticmethod
+    def _exemplars(snap: dict, rule: dict, limit: int = 8) -> List[str]:
+        """Exemplar trace ids for a firing rule, harvested from its
+        metric's histogram reservoirs — slowest buckets first, because
+        the tail is what the page is ABOUT. Empty when the metric has
+        no histogram (counter/gauge rules) or no exemplars recorded."""
+        def _bound(label: str) -> float:
+            return float("inf") if label == "+Inf" else float(label)
+
+        ids: List[str] = []
+        for h in _match(snap.get("histograms", {}), rule["metric"],
+                        rule.get("labels")):
+            ex = h.get("exemplars")
+            if not ex:
+                continue
+            for label in sorted(ex, key=_bound, reverse=True):
+                for tid, _v in ex[label]:
+                    if tid not in ids:
+                        ids.append(tid)
+        return ids[:limit]
+
     def evaluate(self,
                  snap: Optional[dict] = None) -> Dict[str, List[str]]:
         """One evaluation pass; returns the transitions
@@ -444,6 +465,14 @@ class AlertEngine:
                         st["since_eval"] = self.evals
                         st["since"] = time.time()
                         st["fired_count"] += 1
+                        ex = self._exemplars(snap, rule)
+                        if ex:
+                            # the firing carries concrete evidence:
+                            # trace ids from the metric's histogram
+                            # reservoir, slowest buckets first —
+                            # resolvable in the postmortem bundle's
+                            # span dump / merged Perfetto timeline
+                            st["exemplars"] = ex
                         fired.append(rule["name"])
                 else:
                     st["pending"] = 0
@@ -458,9 +487,12 @@ class AlertEngine:
         if self.trace is not None:
             from rdma_paxos_tpu.obs import trace as _trace
             for n in fired:
-                self.trace.record(_trace.ALERT_FIRED, alert=n,
-                                  severity=self._st[n]["severity"],
-                                  value=self._st[n]["value"])
+                kw = dict(alert=n,
+                          severity=self._st[n]["severity"],
+                          value=self._st[n]["value"])
+                if self._st[n].get("exemplars"):
+                    kw["exemplars"] = self._st[n]["exemplars"]
+                self.trace.record(_trace.ALERT_FIRED, **kw)
             for n in resolved:
                 self.trace.record(_trace.ALERT_RESOLVED, alert=n)
         for n in fired:

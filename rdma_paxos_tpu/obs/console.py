@@ -173,6 +173,20 @@ def _topo_state(health: dict) -> str:
     return s
 
 
+def _blame_state(health: dict) -> str:
+    """Critical-path blame column: the dominant latency phase at p99
+    (``blame`` health entry — the tracectx blame summary, None while
+    span sampling is off or no command completed)."""
+    bl = health.get("blame")
+    if not bl:
+        return "-"
+    s = f"p99:{bl.get('p99', '?')}"
+    us = bl.get("p99_us")
+    if us is not None:
+        s += f" {us:.0f}us"
+    return s
+
+
 def _firing_alerts(state: Optional[dict]) -> List[dict]:
     out = []
     for name, st in (state or {}).items():
@@ -227,7 +241,8 @@ def fleet_view(sources: List[dict]) -> dict:
                     reads=(reads if g == 0 else {}),
                     repair=_repair_state(h),
                     txn=(_txn_state(h) if g == 0 else "-"),
-                    topo=(_topo_state(h) if g == 0 else "-")))
+                    topo=(_topo_state(h) if g == 0 else "-"),
+                    blame=(_blame_state(h) if g == 0 else "-")))
         elif isinstance(h.get("replicas"), list):   # single-group
             hosts.append(dict(src=src, kind="cluster", age_s=age,
                               loop_error=h.get("loop_error")))
@@ -242,7 +257,8 @@ def fleet_view(sources: List[dict]) -> dict:
                 reads=_reads_by_path(h),
                 repair=_repair_state(h),
                 txn=_txn_state(h),
-                topo=_topo_state(h)))
+                topo=_topo_state(h),
+                blame=_blame_state(h)))
         elif "replica" in h:                        # one member file
             hosts.append(dict(src=src, kind="replica",
                               replica=h.get("replica"), age_s=age))
@@ -264,7 +280,7 @@ def fleet_view(sources: List[dict]) -> dict:
             term=_imax(h.get("term") for _, h in members),
             commit=_imax(h.get("commit") for _, h in members),
             apply=_imax(h.get("apply") for _, h in members),
-            reads={}, repair="-", txn="-", topo="-",
+            reads={}, repair="-", txn="-", topo="-", blame="-",
             members=len(members)))
 
     # dedupe alerts by name, keeping the longest-firing instance
@@ -302,7 +318,7 @@ def render_table(view: dict, prev: Optional[dict] = None) -> str:
             prev_reads[(r["src"], r["group"])] = r["reads"]
     hdr = (f"{'GROUP':<6} {'LEADER':<7} {'LEASE':<6} {'TERM':<6} "
            f"{'COMMIT':<10} {'APPLY':<10} {'REPAIR':<14} "
-           f"{'TXN':<12} {'TOPO':<12} READS")
+           f"{'TXN':<12} {'TOPO':<12} {'BLAME':<18} READS")
     lines = [hdr, "-" * len(hdr)]
     for r in view["groups"]:
         def cell(v, dash="-"):
@@ -314,6 +330,7 @@ def render_table(view: dict, prev: Optional[dict] = None) -> str:
             f"{str(r['repair']):<14} "
             f"{str(r.get('txn', '-')):<12} "
             f"{str(r.get('topo', '-')):<12} "
+            f"{str(r.get('blame', '-')):<18} "
             + _fmt_reads(r["reads"],
                          prev_reads.get((r["src"], r["group"])), dt))
     if view["alerts"]:
@@ -412,6 +429,7 @@ def assemble_bundle(*, reason: str = "",
                                       lines=_series_lines(jl))
         for name, pats in (
                 ("spans", ["spans.json"]),
+                ("traces", ["traces.json"]),
                 ("audit", ["audit_dump.json", "replica*.audit.json"]),
                 ("trace", ["trace_dump.json"]),
                 ("telemetry", ["metrics.json"])):
@@ -460,6 +478,21 @@ def assemble_bundle(*, reason: str = "",
                 sections[name] = _read_json(path)
     if health:
         sections["health"] = [_read_json(p) for p in health]
+
+    if "spans" in sections:
+        # pre-merge the Perfetto timeline (spans + subsystem traces on
+        # the shared clock) so the bundle is directly loadable in
+        # https://ui.perfetto.dev — an alert exemplar's trace id
+        # resolves here without re-running the merge CLI
+        try:
+            from rdma_paxos_tpu.obs.tracectx import merge_timeline
+            sd = sections["spans"]
+            td = sections.get("traces", [])
+            sections["perfetto"] = merge_timeline(
+                sd if isinstance(sd, list) else [sd],
+                td if isinstance(td, list) else [td])
+        except Exception:           # noqa: BLE001 — best-effort gather
+            pass
 
     manifest = {name: dict(sha256=_sha256(sec),
                            bytes=len(_canonical(sec)))

@@ -84,15 +84,27 @@ def render_prometheus(snap: dict) -> str:
         base, pairs = _split(key)
         base = _prom_name(base)
         out = fam(base, "histogram")
+        ex = h.get("exemplars") or {}
+
+        def tail(bound: str) -> str:
+            # OpenMetrics exemplar syntax: append the bucket's most
+            # recent sampled trace to its `_bucket` line. Absent
+            # exemplars leave the v0.0.4 line byte-identical.
+            res = ex.get(bound)
+            if not res:
+                return ""
+            tid, v = res[-1]
+            return f' # {{trace_id="{_escape(tid)}"}} {v}'
+
         cum = 0
         for bound, c in h["buckets"].items():
             if bound == "+Inf":
                 continue
             cum += c
             le = _prom_labels(pairs, extra=f'le="{bound}"')
-            out.append(f"{base}_bucket{le} {cum}")
+            out.append(f"{base}_bucket{le} {cum}{tail(bound)}")
         inf = _prom_labels(pairs, extra='le="+Inf"')
-        out.append(f"{base}_bucket{inf} {h['count']}")
+        out.append(f"{base}_bucket{inf} {h['count']}{tail('+Inf')}")
         out.append(f"{base}_sum{_prom_labels(pairs)} {h['sum']}")
         out.append(f"{base}_count{_prom_labels(pairs)} {h['count']}")
     lines = []

@@ -46,6 +46,7 @@ CLUSTER_HEALTH_FIELDS = (
     "reads",                 # ReadHub.status() or None
     "streams",               # StreamHub.status() or None
     "txn",                   # TxnCoordinator.health() or None
+    "blame",                 # tracectx.health_blame() or None
     "ts",
 )
 

@@ -77,8 +77,15 @@ def parse_key(key: str) -> Tuple[str, List[Tuple[str, str]]]:
     return base, pairs
 
 
+# per-bucket exemplar reservoir bound: enough to hand a pager a few
+# concrete slow traces, small enough that a histogram stays a few
+# hundred bytes
+EXEMPLARS_PER_BUCKET = 4
+
+
 class _Hist:
-    __slots__ = ("bounds", "counts", "count", "sum", "min", "max")
+    __slots__ = ("bounds", "counts", "count", "sum", "min", "max",
+                 "exemplars")
 
     def __init__(self, bounds: Sequence[float]):
         self.bounds = tuple(float(b) for b in bounds)
@@ -87,22 +94,50 @@ class _Hist:
         self.sum = 0.0
         self.min = float("inf")
         self.max = float("-inf")
+        # bucket index -> bounded [(trace_id, value)] reservoir; lazy
+        # (None until the first exemplar) so exemplar-free histograms
+        # cost nothing and snapshot byte-identically to before
+        self.exemplars: Optional[Dict[int, list]] = None
 
-    def observe(self, value: float) -> None:
-        self.counts[bisect.bisect_left(self.bounds, value)] += 1
+    def observe(self, value: float,
+                exemplar: Optional[str] = None) -> None:
+        b = bisect.bisect_left(self.bounds, value)
+        self.counts[b] += 1
         self.count += 1
         self.sum += value
         if value < self.min:
             self.min = value
         if value > self.max:
             self.max = value
+        if exemplar is not None:
+            if self.exemplars is None:
+                self.exemplars = {}
+            res = self.exemplars.setdefault(b, [])
+            if len(res) < EXEMPLARS_PER_BUCKET:
+                res.append((exemplar, value))
+            else:
+                # deterministic replacement (no RNG): the slot cycles
+                # with the observation count, so the reservoir keeps a
+                # moving sample of recent exemplars per bucket
+                res[self.count % EXEMPLARS_PER_BUCKET] = (exemplar,
+                                                          value)
+
+    def _bucket_label(self, b: int) -> str:
+        return repr(self.bounds[b]) if b < len(self.bounds) else "+Inf"
 
     def as_dict(self) -> dict:
         buckets = {repr(b): c for b, c in zip(self.bounds, self.counts)}
         buckets["+Inf"] = self.counts[-1]
-        return dict(buckets=buckets, count=self.count, sum=self.sum,
-                    min=(self.min if self.count else None),
-                    max=(self.max if self.count else None))
+        out = dict(buckets=buckets, count=self.count, sum=self.sum,
+                   min=(self.min if self.count else None),
+                   max=(self.max if self.count else None))
+        if self.exemplars:
+            # only when exemplars exist: exemplar-free snapshots keep
+            # the pre-exemplar schema byte-for-byte
+            out["exemplars"] = {
+                self._bucket_label(b): [[tid, v] for tid, v in res]
+                for b, res in sorted(self.exemplars.items()) if res}
+        return out
 
 
 class MetricsRegistry:
@@ -127,11 +162,13 @@ class MetricsRegistry:
 
     def observe(self, name: str, value: float,
                 buckets: Optional[Sequence[float]] = None,
-                **labels) -> None:
+                exemplar: Optional[str] = None, **labels) -> None:
         """Record ``value`` into histogram ``name``. ``buckets`` fixes
         the bucket upper bounds on FIRST use of a series; later calls
         reuse the established ladder (fixed-bucket by design — merges
-        and snapshots never re-bin)."""
+        and snapshots never re-bin). ``exemplar`` attaches a trace id
+        to the value's bucket (bounded reservoir) — the join between a
+        latency histogram and the span that produced its tail."""
         k = _key(name, labels)
         with self._lock:
             h = self._hists.get(k)
@@ -139,7 +176,7 @@ class MetricsRegistry:
                 h = _Hist(buckets if buckets is not None
                           else LATENCY_BUCKETS_S)
                 self._hists[k] = h
-            h.observe(float(value))
+            h.observe(float(value), exemplar)
 
     # ---------------- reading ----------------
 

@@ -53,6 +53,7 @@ import argparse
 import collections
 import heapq
 import json
+import os
 import sys
 import threading
 import time
@@ -86,6 +87,28 @@ FAILOVER = "failover"    # failed at deposition / step-down / stop
 
 DEFAULT_SAMPLE_EVERY = 64
 DEFAULT_CAPACITY = 4096
+
+# runtime override for the sampling rate: every recorder built without
+# an explicit ``sample_every`` (the driver, the sharded driver, the
+# RP_GOVERNOR daemon — all construct a default Observability) honors
+# it. 0 disables tracing entirely; garbage falls back to the default.
+SAMPLE_ENV = "RP_TRACE_SAMPLE"
+
+
+def default_sample_every() -> int:
+    raw = os.environ.get(SAMPLE_ENV)
+    if raw is None:
+        return DEFAULT_SAMPLE_EVERY
+    try:
+        return max(0, int(raw))
+    except ValueError:
+        return DEFAULT_SAMPLE_EVERY
+
+
+def span_trace_id(conn: int, req: int) -> str:
+    """The stable external id of one command span — what exemplars
+    carry and what ``obs blame``/Perfetto label spans as."""
+    return f"c{conn}/r{req}"
 
 
 class _Span:
@@ -139,9 +162,13 @@ class SpanRecorder:
     """
 
     def __init__(self, capacity: int = DEFAULT_CAPACITY,
-                 sample_every: int = DEFAULT_SAMPLE_EVERY,
+                 sample_every: Optional[int] = None,
                  clock=time.monotonic):
         self.capacity = capacity
+        if sample_every is None:
+            # resolved at construction (not import) so a test/daemon
+            # that sets RP_TRACE_SAMPLE after import still wins
+            sample_every = default_sample_every()
         self.sample_every = max(0, int(sample_every))  # 0 = disabled
         self._clock = clock
         self._lock = threading.Lock()
@@ -325,12 +352,16 @@ class SpanRecorder:
     def apply_advance(self, replica: int, upto: int) -> None:
         self._frontier(self._await_apply, replica, upto, APPLY)
 
-    def ack_release(self, replica: int, upto_req: int) -> None:
+    def ack_release(self, replica: int,
+                    upto_req: int) -> List[Tuple[int, int]]:
         """The driver released client acks on ``replica`` for every
-        submit sequence <= ``upto_req``."""
+        submit sequence <= ``upto_req``. Returns the ``(conn, req)``
+        keys of the SAMPLED spans acked by this call — the driver's
+        latency observe attaches histogram exemplars only to those."""
         h = self._await_ack.get(replica)
         if not h:
-            return
+            return []
+        acked: List[Tuple[int, int]] = []
         with self._lock:
             ts = self._clock()
             while h and h[0][0] <= upto_req:
@@ -340,10 +371,12 @@ class SpanRecorder:
                     continue
                 sp.events.append((ACK, replica, ts))
                 sp.status = DONE
+                acked.append(key)
                 if sp.pending_marks <= 0:
                     self._retire_locked(key, sp)
                 else:
                     self._done_pending[key] = None
+        return acked
 
     def ack_key(self, conn: int, req: int) -> None:
         """Direct-key client ack (KVS sessions, which observe commit
@@ -408,23 +441,28 @@ class SpanRecorder:
     # ---------------- queries / export ----------------
 
     def read_span(self, replica: int, path: str, t0: float, *,
-                  group: int = -1, status: str = DONE) -> bool:
+                  group: int = -1, status: str = DONE) -> Optional[str]:
         """Record one served linearizable READ as a lightweight span
         (sampled like commands, but on a separate counter): the read
         critical path is just [enqueue, serve] on the serving replica
         — no append/commit/apply correlation to carry. Rendered as
         duration slices on a dedicated reads track by
-        :func:`to_chrome_trace`."""
+        :func:`to_chrome_trace`. Returns the read's trace id when
+        sampled (truthy, so pre-existing boolean callers still work),
+        None otherwise — the id feeds the read-latency histogram's
+        exemplar."""
         if not self.sample_every:
-            return False
+            return None
         with self._lock:
             self._read_counter += 1
             if (self._read_counter - 1) % self.sample_every:
-                return False
+                return None
+            rid = f"read-{self._read_counter - 1}"
             self._reads.append(dict(replica=int(replica), path=path,
                                     t0=float(t0), t1=self._clock(),
-                                    group=int(group), status=status))
-            return True
+                                    group=int(group), status=status,
+                                    id=rid))
+            return rid
 
     def key_for(self, term: int, index: int,
                 group: int = -1) -> Optional[Tuple[int, int]]:

@@ -40,7 +40,8 @@ from rdma_paxos_tpu.obs.health import (
     HealthReporter, make_cluster_snapshot, make_snapshot)
 from rdma_paxos_tpu.obs.metrics import (
     BATCH_BUCKETS, LATENCY_BUCKETS_S, LATENCY_BUCKETS_US)
-from rdma_paxos_tpu.obs.spans import StepPhaseProfiler
+from rdma_paxos_tpu.obs.spans import StepPhaseProfiler, span_trace_id
+from rdma_paxos_tpu.obs.tracectx import health_blame as _health_blame
 from rdma_paxos_tpu.proxy.proxy import (
     PendingEvent, ProxyServer, ReplayEngine, spec_send_refused_dirty)
 from rdma_paxos_tpu.proxy.stablestore import (
@@ -1072,6 +1073,7 @@ class ClusterDriver:
                       if self.governor is not None else None),
             txn=(self.cluster.txn.health()
                  if self.cluster.txn is not None else None),
+            blame=_health_blame(self.obs),
         )
 
     # ------------------------------------------------------------------
@@ -1587,21 +1589,28 @@ class ClusterDriver:
             releases = []
             with self._lock:
                 while rt.inflight and rt.inflight[0][1] <= own_max:
-                    ev, _ = rt.inflight.popleft()
-                    releases.append(ev)
+                    ev, seq = rt.inflight.popleft()
+                    releases.append((ev, seq))
+            # spans first so the latency observe below can attach the
+            # SAMPLED releases' span ids as histogram exemplars
+            sampled = {}
+            if releases:
+                self.obs.trace.record(obs_trace.PROXY_ACK_RELEASE,
+                                      replica=r, count=len(releases),
+                                      submit_seq=own_max)
+                sampled = {req: conn for conn, req
+                           in self.obs.spans.ack_release(r, own_max)}
             now = time.perf_counter()
-            for ev in releases:
+            for ev, seq in releases:
                 ev.release(0)
                 # intake→release is the client-visible commit latency
                 # (the spin at proxy.c:160, measured instead of spun)
                 self.obs.metrics.observe(
                     "commit_latency_seconds", now - ev.t0,
-                    buckets=LATENCY_BUCKETS_S, replica=r)
-            if releases:
-                self.obs.trace.record(obs_trace.PROXY_ACK_RELEASE,
-                                      replica=r, count=len(releases),
-                                      submit_seq=own_max)
-                self.obs.spans.ack_release(r, own_max)
+                    buckets=LATENCY_BUCKETS_S,
+                    exemplar=(span_trace_id(sampled[seq], seq)
+                              if seq in sampled else None),
+                    replica=r)
             self._phase_prof.stop("ack_release")
         self._phase_prof.stop("apply_replay_ack")
 

@@ -100,13 +100,18 @@ def count_read(obs, path: str, replica: int, *,
     obs.metrics.inc("reads_served_total", n, **labels)
     if t0 is not None:
         now = time.monotonic()
-        obs.metrics.observe("read_latency_us", (now - t0) * 1e6,
-                            buckets=LATENCY_BUCKETS_US, path=path)
         from rdma_paxos_tpu.obs.spans import active_recorder
         rec = active_recorder(obs)
+        rid = None
         if rec is not None:
-            rec.read_span(replica, path, t0,
-                          group=(-1 if group is None else group))
+            # span first: a sampled read's id becomes the latency
+            # histogram's exemplar, so a read-SLO page resolves to a
+            # concrete read span on the merged timeline
+            rid = rec.read_span(replica, path, t0,
+                                group=(-1 if group is None else group))
+        obs.metrics.observe("read_latency_us", (now - t0) * 1e6,
+                            buckets=LATENCY_BUCKETS_US, exemplar=rid,
+                            path=path)
 
 
 def read_counts(obs) -> Dict[str, int]:

@@ -49,6 +49,8 @@ from rdma_paxos_tpu.consensus.state import Role
 from rdma_paxos_tpu.obs import trace as obs_trace
 from rdma_paxos_tpu.obs.health import make_snapshot
 from rdma_paxos_tpu.obs.metrics import LATENCY_BUCKETS_S
+from rdma_paxos_tpu.obs.spans import span_trace_id
+from rdma_paxos_tpu.obs.tracectx import health_blame as _health_blame
 from rdma_paxos_tpu.proxy.proxy import PendingEvent
 from rdma_paxos_tpu.runtime.driver import ClusterDriver, conn_origin
 from rdma_paxos_tpu.runtime.hostpath import plan_segment
@@ -546,6 +548,7 @@ class ShardedClusterDriver(ClusterDriver):
         c = self.cluster
         progressed = False
         releases: list = []
+        sampled: set = set()      # (conn, req) span keys acked now
         replaying = rt.replay is not None and not rt.app_dirty
 
         def own_of(conns, _gens):
@@ -584,13 +587,15 @@ class ShardedClusterDriver(ClusterDriver):
                 with self._lock:
                     dq = self._inflight_g[r][g]
                     while dq and dq[0][1] <= own_max:
-                        ev, _ = dq.popleft()
-                        releases.append(ev)
+                        ev, seq = dq.popleft()
+                        releases.append((ev, seq))
                 # span acks live on the GROUP-NAMESPACED track the
                 # enqueue-side begin() used — (group, term, index)
-                # correlation closes here
-                self.obs.spans.ack_release(self._span_rep(g, r),
-                                           own_max)
+                # correlation closes here; sampled keys feed the
+                # latency histogram's exemplars below
+                sampled.update(
+                    self.obs.spans.ack_release(self._span_rep(g, r),
+                                               own_max))
                 self._phase_prof.stop("ack_release")
         self._phase_prof.stop("apply_replay_ack")
         if progressed and replaying:
@@ -601,12 +606,16 @@ class ShardedClusterDriver(ClusterDriver):
                 rt.store.sync()
                 rt.last_sync = now
         if releases:
+            acked = {req: conn for conn, req in sampled}
             now = time.perf_counter()
-            for ev in releases:
+            for ev, seq in releases:
                 ev.release(0)
                 self.obs.metrics.observe(
                     "commit_latency_seconds", now - ev.t0,
-                    buckets=LATENCY_BUCKETS_S, replica=r)
+                    buckets=LATENCY_BUCKETS_S,
+                    exemplar=(span_trace_id(acked[seq], seq)
+                              if seq in acked else None),
+                    replica=r)
             self.obs.trace.record(obs_trace.PROXY_ACK_RELEASE,
                                   replica=r, count=len(releases))
 
@@ -663,7 +672,8 @@ class ShardedClusterDriver(ClusterDriver):
             governor=(self.governor.status()
                       if self.governor is not None else None),
             txn=(self.cluster.txn.health()
-                 if self.cluster.txn is not None else None))
+                 if self.cluster.txn is not None else None),
+            blame=_health_blame(self.obs))
         return make_cluster_snapshot(**h)
 
     def read(self, fn=None, *, key=None, group: Optional[int] = None,

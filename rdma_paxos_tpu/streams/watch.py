@@ -156,6 +156,11 @@ class WatchHub:
         self._wnext_id = 1        # guarded-by: _wlock
         self._wstopped = False    # guarded-by: _wlock
         self.events_total = 0     # guarded-by: _wlock
+        # earliest un-dispatched kick timestamp per group — anchors a
+        # watch-delivery trace at COMMIT time, so the merged timeline
+        # shows commit → pump → deliver. Only populated while the
+        # trace plane samples.  # guarded-by: _wlock
+        self._wkick: Dict[int, float] = {}
         from rdma_paxos_tpu.analysis import runtime_guard
         runtime_guard.maybe_guard(self, "_wlock", __file__)
         self._pump = threading.Thread(
@@ -238,10 +243,17 @@ class WatchHub:
         """New committed frontier (engine finish() tail, readback
         thread): record per-group targets and wake the pump. O(G) —
         never decodes, never blocks on a consumer."""
+        from rdma_paxos_tpu.obs.tracectx import active_tracer
+        tr = active_tracer(self.obs)
         with self._wlock:
             for g, n in lengths.items():
                 if n > self._wtarget.get(g, 0):
                     self._wtarget[g] = n
+                    if tr is not None:
+                        # keep the EARLIEST pending kick: latency is
+                        # measured from the first commit the pump has
+                        # not yet caught up to
+                        self._wkick.setdefault(g, tr.now())
             self._wcv.notify_all()
 
     def wait_caught_up(self, lengths: Dict[int, int],
@@ -304,6 +316,16 @@ class WatchHub:
                 self._dispatch(g, lo, hi, recs)
 
     def _dispatch(self, g: int, lo: int, hi: int, recs) -> None:
+        from rdma_paxos_tpu.obs.tracectx import active_tracer
+        tr = active_tracer(self.obs)
+        tid = None
+        if tr is not None:
+            with self._wlock:
+                k0 = self._wkick.pop(g, None)
+            # t0 = the kick (commit frontier advance); "pump" marks
+            # when the pump thread actually picked the batch up
+            tid = tr.begin("watch", ts=k0, group=g, lo=lo, hi=hi)
+            tr.phase(tid, "pump")
         if self.cdc is not None:
             self.cdc.write_records(g, recs)
         events = []
@@ -346,6 +368,9 @@ class WatchHub:
                     delivered += 1
             self.events_total += delivered
             self._wcv.notify_all()
+        if tid is not None:
+            tr.phase(tid, "deliver")
+            tr.end(tid, events=len(events), delivered=delivered)
         if self.obs is not None and events:
             self.obs.metrics.inc("watch_events_delivered_total",
                                  delivered, group=g)

@@ -147,6 +147,16 @@ class TopologyController:
         # digests of the last verified pass (status/trace export)
         # guarded-by: _lock [writes]
         self._last_digest: Dict[int, str] = {}
+        # trace-plane ids of the ACTIVE and the LAST transition-window
+        # trace. Written only under _lock; READ lock-free (plain
+        # attribute load) by the txn coordinator when it blames a
+        # TOPOLOGY abort on the window — the coordinator must never
+        # take this lock (drive() calls txn.wants_serial() while
+        # holding it: taking _lock from under the coordinator's lock
+        # would be the ABBA inversion).
+        # guarded-by: _lock [writes]
+        self.window_trace: Optional[str] = None
+        self.last_window_trace: Optional[str] = None  # guarded-by: _lock [writes]
         self._lock = threading.RLock()
         # client write gate: while a range is frozen, put/remove/txn
         # admissions for its keys wait here until cutover or abandon.
@@ -158,6 +168,12 @@ class TopologyController:
         self._frozen_range: Optional[Tuple[bytes, Optional[bytes]]] = None
         from rdma_paxos_tpu.analysis import runtime_guard
         runtime_guard.maybe_guard(self, "_lock", __file__)
+
+    def _tracer(self):
+        """The shared trace plane, or None when span sampling is off
+        (one switch silences spans AND subsystem traces)."""
+        from rdma_paxos_tpu.obs.tracectx import active_tracer
+        return active_tracer(self.obs)
 
     # ---------------- proposals ----------------
 
@@ -193,6 +209,13 @@ class TopologyController:
             self._records.clear()
             self._last_digest = {}
             self._phase = SEED
+            tr = self._tracer()
+            if tr is not None:
+                # TraceContext is leaf-locked: safe to call under _lock
+                self.window_trace = tr.begin(
+                    "topology", direction=direction,
+                    group=rule.group, lo=rule.lo.hex(),
+                    hi=rule.hi.hex() if rule.hi is not None else None)
         self._trace(obs_trace.TOPOLOGY_PROPOSED, direction=direction,
                     lo=rule.lo.hex(),
                     hi=rule.hi.hex() if rule.hi is not None else None,
@@ -356,6 +379,9 @@ class TopologyController:
                 self._freeze_deadline = step + self.freeze_deadline_steps
                 with self._gate_cv:
                     self._frozen_range = (self._rule.lo, self._rule.hi)
+                tr = self._tracer()
+                if tr is not None and self.window_trace is not None:
+                    tr.phase(self.window_trace, "freeze")
                 self._trace(obs_trace.TOPOLOGY_FROZEN,
                             direction=self._direction, step=step,
                             deadline=self._freeze_deadline)
@@ -381,6 +407,9 @@ class TopologyController:
                     return          # raced — next pass re-diffs
                 digests[t] = range_digest(want)
             self._last_digest = digests
+            tr = self._tracer()
+            if tr is not None and self.window_trace is not None:
+                tr.phase(self.window_trace, "verify", once=True)
             self._trace(obs_trace.TOPOLOGY_VERIFIED,
                         direction=self._direction, step=step,
                         digests={str(t): d for t, d in digests.items()})
@@ -465,6 +494,11 @@ class TopologyController:
             self.cluster.submit(g, lead if lead >= 0 else 0, payload,
                                 conn=self._conn(g, req), req_id=req)
             n += 1
+        tr = self._tracer()
+        if tr is not None and self.window_trace is not None:
+            # once=True: the FIRST seed pass marks the phase; catch-up
+            # passes annotate cumulative record counts instead
+            tr.phase(self.window_trace, "seed", once=True)
         self._trace(obs_trace.TOPOLOGY_SEEDED,
                     direction=self._direction, records=n,
                     step=self.cluster.step_index, initial=first)
@@ -491,6 +525,12 @@ class TopologyController:
         donors = sorted(self._affected - {self._rule.group}) \
             if self._direction == "split" else [self._rule.group]
         targets = sorted(self._affected - set(donors))
+        tr = self._tracer()
+        if tr is not None and self.window_trace is not None:
+            tr.phase(self.window_trace, "cutover")
+            tr.annotate(self.window_trace, epoch=ep,
+                        router_version=version, donors=donors,
+                        targets=targets)
         self._trace(obs_trace.TOPOLOGY_CUTOVER,
                     direction=self._direction, step=step, epoch=ep,
                     router_version=version, donors=donors,
@@ -510,6 +550,9 @@ class TopologyController:
     # holds-lock: _lock
     def _abandon(self, reason: str) -> None:
         self.abandoned_total += 1
+        tr = self._tracer()
+        if tr is not None and self.window_trace is not None:
+            tr.annotate(self.window_trace, reason=reason)
         self._trace(obs_trace.TOPOLOGY_ABANDONED,
                     direction=self._direction, reason=reason,
                     step=self.cluster.step_index)
@@ -527,6 +570,16 @@ class TopologyController:
                         direction=self._direction,
                         step=self.cluster.step_index,
                         epoch=self.epoch.current())
+        tr = self._tracer()
+        if tr is not None and self.window_trace is not None:
+            tr.end(self.window_trace,
+                   status=("done" if done else "abandoned"))
+        if self.window_trace is not None:
+            # pointer swap, still under _lock: an in-flight TOPOLOGY
+            # abort races the close and must still find the window it
+            # was aborted by (coordinator falls back to this one)
+            self.last_window_trace = self.window_trace
+            self.window_trace = None
         self._phase = IDLE
         self._direction = None
         self._rule = None

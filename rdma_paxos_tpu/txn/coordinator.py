@@ -88,6 +88,15 @@ class Txn:
         # retries exactly-once), surviving leader failover
         self.record_retry: Dict[Tuple[int, int], int] = {}
         self.reads: Dict[bytes, Optional[bytes]] = {}
+        # trace-plane bookkeeping: the txn-level trace id (None when
+        # tracing is off), the (group, req) keys of every record span
+        # this txn opened and has not yet closed (prepare + decision/
+        # merge — the coordinator OWNS their closure; before PR 20
+        # they leaked open), and the per-group prepare reqs so a
+        # group's prepare spans close the moment it votes PREPARED
+        self.trace_id: Optional[str] = None
+        self.span_keys: set = set()
+        self.prep_reqs: Dict[int, List[int]] = {}
         # routing snapshot at admission: the router version the
         # key→group mapping was computed under, and every (group, key)
         # placement it produced — an elastic cutover bumps the version
@@ -162,6 +171,41 @@ class TxnCoordinator:
         from rdma_paxos_tpu.analysis import runtime_guard
         runtime_guard.maybe_guard(self, "_lock", __file__)
 
+    # ---------------- trace plane ----------------
+
+    def _tracer(self):
+        """The cluster's TraceContext iff tracing is enabled. Safe to
+        call (and to use) under ``_lock``: the trace store is
+        leaf-locked and this coordinator NEVER takes the topology
+        controller's lock (drive() holds that lock while calling our
+        ``wants_serial`` — the reverse order would deadlock ABBA; the
+        window-trace handoff below is a lock-free attribute read)."""
+        from rdma_paxos_tpu.obs.tracectx import active_tracer
+        return active_tracer(getattr(self.cluster, "obs", None))
+
+    # holds-lock: _lock
+    def _close_record_spans(self, txn: Txn, keys, *, ok: bool,
+                            status: str = "aborted") -> None:
+        """Close record spans this txn opened — DONE when the record
+        reached its outcome, else a terminal status carrying the abort
+        reason (the fail_open discipline from runtime/node.py: spans
+        terminate, never leak)."""
+        from rdma_paxos_tpu.obs.spans import active_recorder
+        spans = active_recorder(getattr(self.cluster, "obs", None))
+        for (g, req) in list(keys):
+            if spans is not None:
+                if ok:
+                    spans.ack_key(self._conn(g, req), req)
+                else:
+                    spans.fail_key(self._conn(g, req), req,
+                                   status=status)
+            txn.span_keys.discard((g, req))
+
+    # holds-lock: _lock
+    def _close_prep_spans(self, txn: Txn, g: int) -> None:
+        self._close_record_spans(
+            txn, [(g, r) for r in txn.prep_reqs.get(g, ())], ok=True)
+
     # ---------------- admission ----------------
 
     def begin(self, writes: Sequence[Tuple[int, bytes, bytes]],
@@ -193,6 +237,11 @@ class TxnCoordinator:
             txn = Txn(tid, by_group, reads,
                       self.cluster.step_index + self.timeout_steps,
                       fast)
+            tr = self._tracer()
+            if tr is not None:
+                txn.trace_id = tr.begin("txn", txn=tid,
+                                        groups=list(txn.groups),
+                                        fast=bool(fast))
             locked: List[Tuple[int, bytes]] = []
             ok = True
             for g, ws in by_group.items():
@@ -212,16 +261,26 @@ class TxnCoordinator:
                 txn.state = ABORTED
                 txn.reason = "conflict"
                 self._count_abort("conflict")
+                if tr is not None and txn.trace_id is not None:
+                    tr.end(txn.trace_id, status="aborted",
+                           reason="conflict")
                 return txn
             txn.router_version = getattr(self.kvs.router, "version", 0)
             txn.admitted = locked
             self._txns[tid] = txn
             if fast:
+                if tr is not None and txn.trace_id is not None:
+                    tr.phase(txn.trace_id, "merge")
                 self._submit_merge(txn)
             elif self._active_2pc is None:
                 self._active_2pc = tid
                 self._submit_prepares(txn)
             else:
+                if tr is not None and txn.trace_id is not None:
+                    # queued behind the commit lane: the interval up
+                    # to promotion's "prepare" phase is the blame
+                    # report's txn_lock component
+                    tr.phase(txn.trace_id, "lock_wait")
                 self._queue.append(tid)
         return txn
 
@@ -262,19 +321,31 @@ class TxnCoordinator:
             spans.begin(self._conn(g, req), req,
                         self.cluster._span_rep(g, lead),
                         phase="submit")
+            txn.span_keys.add((g, req))
+            tr = self._tracer()
+            if tr is not None and txn.trace_id is not None:
+                # child link: the record's span key joins it to the
+                # txn-level trace on the merged timeline
+                tr.link(txn.trace_id, self._conn(g, req), req, g)
         self.cluster.submit(g, lead, payload, conn=self._conn(g, req),
                             req_id=req)
         return req
 
     # holds-lock: _lock
     def _submit_prepares(self, txn: Txn) -> None:
+        tr = self._tracer()
+        if tr is not None and txn.trace_id is not None:
+            tr.phase(txn.trace_id, "prepare")
         for g in txn.groups:
             txn.prep_appended[g] = 0
             for op, key, val in txn.writes_by_group[g]:
-                self._submit_record(
+                req = self._submit_record(
                     txn, g, _records.encode_prepare(txn.tid, op, key,
                                                     val))
+                txn.prep_reqs.setdefault(g, []).append(req)
             self._terms.reset(g)        # set at first prepare append
+        if tr is not None and txn.trace_id is not None:
+            tr.phase(txn.trace_id, "vote_wait")
 
     # holds-lock: _lock
     def _submit_merge(self, txn: Txn) -> None:
@@ -421,6 +492,7 @@ class TxnCoordinator:
                                             term_now[g])
                         == _epoch.COMPLETE):
                     txn.prepared.add(g)
+                    self._close_prep_spans(txn, g)
                     self.cluster.clear_txn_watch(g)
                     continue
             if votes is None:
@@ -431,6 +503,7 @@ class TxnCoordinator:
                 return
             if (row == TXN_PREPARED).any():
                 txn.prepared.add(g)
+                self._close_prep_spans(txn, g)
                 self.cluster.clear_txn_watch(g)
         if txn.prepared == set(txn.groups):
             # serialization point: all participants hold the staged
@@ -448,6 +521,9 @@ class TxnCoordinator:
                 reads[key] = val
             txn.reads = reads
             txn.state = COMMITTING
+            tr = self._tracer()
+            if tr is not None and txn.trace_id is not None:
+                tr.phase(txn.trace_id, "decide")
             self._submit_decision(txn, commit=True)
 
     # holds-lock: _lock
@@ -486,6 +562,7 @@ class TxnCoordinator:
                 txn.record_term.pop((g, req), None)
                 txn.record_payload.pop((g, req), None)
                 txn.record_retry.pop((g, req), None)
+                self._close_record_spans(txn, [(g, req)], ok=True)
             elif st == _epoch.INVALIDATED:
                 # forget the placement and retry under the SAME stamp:
                 # if it DID commit, dedup makes the retry a no-op
@@ -511,6 +588,26 @@ class TxnCoordinator:
         txn.reason = reason
         txn.state = ABORTING
         self._count_abort(reason)
+        tr = self._tracer()
+        if tr is not None and txn.trace_id is not None:
+            tr.phase(txn.trace_id, "abort")
+            tr.annotate(txn.trace_id, reason=reason)
+            if reason == "topology":
+                # blame the transition window: re-parent the txn trace
+                # under the topology trace whose freeze made the
+                # mapping move. Lock-free pointer read — taking the
+                # controller's _lock here would invert drive()'s
+                # topo-then-txn lock order (ABBA).
+                topo = getattr(self.cluster, "topology", None)
+                win = (getattr(topo, "window_trace", None)
+                       or getattr(topo, "last_window_trace", None))
+                if win is not None:
+                    tr.set_parent(txn.trace_id, win)
+        # close every span this txn still holds open — the abort
+        # reason rides on the span so a mid-prepare abort never leaks
+        # an open span (satellite: coordinator span-gap fix)
+        self._close_record_spans(txn, list(txn.span_keys), ok=False,
+                                 status="aborted:" + reason)
         # drop any still-outstanding prepare stamps
         for key, tid in list(self._outstanding.items()):
             if tid == txn.tid and key not in txn.record_index:
@@ -539,6 +636,17 @@ class TxnCoordinator:
             obs = getattr(self.cluster, "obs", None)
             if obs is not None:
                 obs.metrics.inc("txn_committed_total")
+        # safety net: any span key still open (decision records of an
+        # aborted txn, crash-interrupted prepares) closes here, then
+        # the txn-level trace ends with the terminal state
+        ok = txn.state == COMMITTED
+        self._close_record_spans(
+            txn, list(txn.span_keys), ok=ok,
+            status="aborted:" + (txn.reason or "unknown"))
+        tr = self._tracer()
+        if tr is not None and txn.trace_id is not None:
+            tr.end(txn.trace_id,
+                   status=("committed" if ok else "aborted"))
         self._release(txn)
 
     # holds-lock: _lock

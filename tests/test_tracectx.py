@@ -1,0 +1,528 @@
+"""obs/tracectx — ONE causal trace plane: acceptance properties.
+
+* ``TraceContext`` is bounded, deterministic (``kind-N`` ids), and
+  leaf-locked; open traces terminate (end / fail_open / eviction),
+  never leak;
+* ``RP_TRACE_SAMPLE`` overrides the span sampling default AND — via
+  :func:`active_tracer` — silences the whole subsystem trace plane
+  with the same switch;
+* latency histograms keep a bounded, deterministic exemplar reservoir
+  per bucket; ``/metrics`` renders OpenMetrics exemplar tails; an
+  AlertEngine firing carries resolvable exemplar trace ids;
+* the chaos schedule (a real topology split window + concurrent
+  cross-group txns + a TOPOLOGY-aborted txn) yields a merged Perfetto
+  timeline that is byte-deterministic per seed, with every span and
+  trace closed and the aborted txn's blocking parent pointing at the
+  transition-window trace;
+* the blame report decomposes per-command latency into the
+  ``BLAME_PHASES`` components and names the dominant phase per
+  percentile; the ``obs`` CLI round-trips merge + blame over dump
+  files;
+* the trace plane is host-side only: STEP_CACHE keys and step
+  outputs are bit-identical with full tracing on.
+"""
+
+import json
+import time
+
+import numpy as np
+
+from rdma_paxos_tpu.config import LogConfig
+from rdma_paxos_tpu.obs import AlertEngine, Observability
+from rdma_paxos_tpu.obs import spans as spans_mod
+from rdma_paxos_tpu.obs.__main__ import main as obs_main
+from rdma_paxos_tpu.obs.console import _blame_state, assemble_bundle
+from rdma_paxos_tpu.obs.export import render_prometheus
+from rdma_paxos_tpu.obs.health import CLUSTER_HEALTH_FIELDS
+from rdma_paxos_tpu.obs.metrics import (
+    EXEMPLARS_PER_BUCKET, MetricsRegistry)
+from rdma_paxos_tpu.obs.spans import SpanRecorder, span_trace_id
+from rdma_paxos_tpu.obs.tracectx import (
+    BLAME_PHASES, SUBSYS_PIDS, TraceContext, active_tracer, blame,
+    blame_summary, format_blame, merge_timeline)
+from rdma_paxos_tpu.runtime import reads as reads_mod
+from rdma_paxos_tpu.runtime.sim import STEP_CACHE
+from rdma_paxos_tpu.shard import ShardedCluster
+from rdma_paxos_tpu.shard.kvs import ShardedKVS
+from rdma_paxos_tpu.shard.router import RangeRule
+from rdma_paxos_tpu.topology import attach_topology
+from rdma_paxos_tpu.txn import attach_coordinator
+from rdma_paxos_tpu.txn.chaos import keys_for_groups
+
+CFG = LogConfig(n_slots=256, slot_bytes=128, window_slots=32,
+                batch_slots=8)
+
+# a fixed anchor makes two runs' dumps (and their merged timeline)
+# byte-comparable: no wall clock leaks into the documents
+ANCHOR = {"monotonic": 0.0, "wall": 1000.0}
+
+
+def _scripted_clock(step_s: float = 0.001, start: float = 0.0):
+    """Deterministic monotonic clock: start+0.001, start+0.002, ..."""
+    t = [start]
+
+    def clock():
+        t[0] += step_s
+        return round(t[0], 6)
+    return clock
+
+
+# ---------------------------------------------------------------------------
+# TraceContext lifecycle
+# ---------------------------------------------------------------------------
+
+def test_trace_lifecycle_deterministic_ids_and_phases():
+    tc = TraceContext(clock=_scripted_clock())
+    a = tc.begin("txn", groups=[0, 1])
+    b = tc.begin("txn")
+    w = tc.begin("topology", direction="split")
+    assert (a, b, w) == ("txn-0", "txn-1", "topology-0")
+    assert tc.open_count == 3
+    tc.phase(a, "lock_wait")
+    tc.phase(a, "prepare")
+    tc.phase(a, "prepare", once=True)            # deduped
+    tc.link(a, 7, 3, 0)
+    tc.annotate(a, reason="conflict")
+    tc.set_parent(a, w)                          # late-bound parent
+    tc.end(a, status="aborted")
+    tc.end(b, status="committed")
+    tc.end(w)
+    assert tc.open_count == 0
+    d = tc.get(a)
+    assert d["status"] == "aborted" and d["parent"] == w
+    assert [p for p, _ in d["phases"]] == ["lock_wait", "prepare"]
+    assert d["links"] == [[7, 3, 0]]
+    assert d["attrs"]["reason"] == "conflict"
+    assert d["t1"] > d["t0"]
+    c = tc.counts()
+    assert c["open"] == 0 and c["done"] == 3 and c["dropped"] == 0
+    assert c["by_kind"] == {"txn": 2, "topology": 1}
+    # unknown/ended ids no-op, never raise
+    tc.phase("nope-9", "x")
+    tc.end(a)
+    assert tc.get("nope-9") is None
+
+
+def test_capacity_eviction_and_fail_open_never_leak():
+    tc = TraceContext(capacity=2, clock=_scripted_clock())
+    t0 = tc.begin("watch")
+    tc.begin("watch")
+    tc.begin("watch")                            # evicts the oldest
+    assert tc.open_count == 2 and tc.dropped == 1
+    assert tc.get(t0)["status"] == "evicted"
+    assert tc.fail_open() == 2                   # driver-crash path
+    assert tc.open_count == 0
+    # bounded: the done deque holds `capacity` entries, so the evicted
+    # record rotated out when the two failover closes landed
+    statuses = {t["status"] for t in tc.dump()["traces"]}
+    assert statuses == {"failover"}
+    tc.reset()
+    assert tc.counts() == dict(open=0, done=0, dropped=0, by_kind={})
+    assert tc.begin("watch") == "watch-0"        # counters reset too
+
+
+# ---------------------------------------------------------------------------
+# RP_TRACE_SAMPLE: one switch for spans AND the subsystem trace plane
+# ---------------------------------------------------------------------------
+
+def test_rp_trace_sample_env_override(monkeypatch):
+    monkeypatch.delenv(spans_mod.SAMPLE_ENV, raising=False)
+    assert (spans_mod.default_sample_every()
+            == spans_mod.DEFAULT_SAMPLE_EVERY)
+    monkeypatch.setenv(spans_mod.SAMPLE_ENV, "7")
+    assert spans_mod.default_sample_every() == 7
+    # resolved at CONSTRUCTION, not import: a recorder built now sees it
+    assert SpanRecorder().sample_every == 7
+    monkeypatch.setenv(spans_mod.SAMPLE_ENV, "not-a-number")
+    assert (spans_mod.default_sample_every()
+            == spans_mod.DEFAULT_SAMPLE_EVERY)
+    monkeypatch.setenv(spans_mod.SAMPLE_ENV, "-3")
+    assert spans_mod.default_sample_every() == 0   # clamped = off
+    monkeypatch.setenv(spans_mod.SAMPLE_ENV, "0")
+    obs = Observability(span_recorder=SpanRecorder())
+    assert not obs.spans.enabled
+    # the SAME switch silences the subsystem trace plane
+    assert active_tracer(obs) is None
+    assert active_tracer(None) is None
+    obs_on = Observability(span_recorder=SpanRecorder(sample_every=1))
+    assert active_tracer(obs_on) is obs_on.tracectx
+
+
+# ---------------------------------------------------------------------------
+# exemplars: reservoir -> /metrics tail -> alert firing evidence
+# ---------------------------------------------------------------------------
+
+def test_exemplar_reservoir_is_bounded_and_deterministic():
+    reg = MetricsRegistry()
+    for i in range(10):
+        reg.observe("commit_latency_seconds", 0.2,
+                    exemplar=span_trace_id(0, i + 1))
+    h = reg.snapshot()["histograms"]["commit_latency_seconds"]
+    (res,) = h["exemplars"].values()
+    assert len(res) == EXEMPLARS_PER_BUCKET     # bounded, one bucket
+    # deterministic replacement (count-cycled slot, no RNG): a second
+    # identical registry produces the identical reservoir
+    reg2 = MetricsRegistry()
+    for i in range(10):
+        reg2.observe("commit_latency_seconds", 0.2,
+                     exemplar=span_trace_id(0, i + 1))
+    assert reg2.snapshot()["histograms"]["commit_latency_seconds"] \
+        == h
+    # exemplar-free histograms snapshot WITHOUT the key (golden-file
+    # compatibility)
+    reg3 = MetricsRegistry()
+    reg3.observe("commit_latency_seconds", 0.2)
+    assert "exemplars" not in \
+        reg3.snapshot()["histograms"]["commit_latency_seconds"]
+
+
+def test_openmetrics_exemplar_tail_rendering():
+    reg = MetricsRegistry()
+    reg.observe("commit_latency_seconds", 0.2,
+                exemplar=span_trace_id(3, 9))
+    text = render_prometheus(reg.snapshot())
+    assert ' # {trace_id="c3/r9"} 0.2' in text
+    # without exemplars the scrape is byte-identical to the classic
+    # v0.0.4 form: no stray exemplar syntax anywhere
+    reg2 = MetricsRegistry()
+    reg2.observe("commit_latency_seconds", 0.2)
+    assert "trace_id" not in render_prometheus(reg2.snapshot())
+
+
+def test_alert_firing_carries_resolvable_exemplars():
+    reg = MetricsRegistry()
+    rule = dict(name="slow_commit", severity="warn",
+                kind="hist_quantile", metric="commit_latency_seconds",
+                q=0.5, op=">", threshold=0.01, for_evals=1)
+    eng = AlertEngine(reg, rules=[rule])
+    # the spans these exemplars resolve against
+    rec = SpanRecorder(sample_every=1, clock=_scripted_clock())
+    for i in range(3):
+        rec.begin(0, i + 1, 0)
+        rec.stamp_append(0, i + 1, term=1, index=i, leader=0,
+                         replicas=(0,))
+    rec.commit_advance(0, 3)
+    rec.apply_advance(0, 3)
+    for conn, req in rec.ack_release(0, 3):
+        reg.observe("commit_latency_seconds", 0.9,
+                    exemplar=span_trace_id(conn, req))
+    out = eng.evaluate()
+    assert "slow_commit" in out["fired"]
+    st = eng.state()["slow_commit"]
+    assert st["firing"] and st["exemplars"]
+    # every attached exemplar RESOLVES to a span in the dump
+    dump = rec.dump(anchor=ANCHOR)
+    span_ids = {span_trace_id(s["conn"], s["req"])
+                for s in dump["spans"]}
+    assert set(st["exemplars"]) <= span_ids
+
+
+# ---------------------------------------------------------------------------
+# blame: per-command latency decomposition + dominant phase
+# ---------------------------------------------------------------------------
+
+def _synthetic_pair():
+    """One fully-retired span plus a txn trace (large lock wait,
+    linking the span) and a topology window overlapping it."""
+    rec = SpanRecorder(sample_every=1, clock=_scripted_clock())
+    rec.begin(7, 1, 0)                          # enqueue t=.001
+    rec.stamp_append(7, 1, term=3, index=5, leader=0, replicas=(0, 1))
+    rec.commit_advance(0, 6)
+    rec.apply_advance(0, 6)
+    rec.commit_advance(1, 6)
+    rec.apply_advance(1, 6)
+    rec.ack_release(0, 1)
+    tc = TraceContext(clock=_scripted_clock())
+    t = tc.begin("txn", ts=0.0)
+    tc.phase(t, "lock_wait", ts=0.0005)
+    tc.phase(t, "prepare", ts=0.0505)           # 50ms lock wait
+    tc.link(t, 7, 1, 0)
+    tc.end(t, status="committed", ts=0.06)
+    w = tc.begin("topology", ts=0.0, direction="split")
+    tc.phase(w, "freeze", ts=0.001)
+    tc.phase(w, "cutover", ts=0.004)
+    tc.end(w, ts=0.005)
+    return rec, tc
+
+
+def test_blame_decomposition_and_dominant_phase():
+    rec, tc = _synthetic_pair()
+    doc = blame([rec.dump(anchor=ANCHOR)], [tc.dump(anchor=ANCHOR)])
+    assert doc["commands"] == 1
+    assert set(doc["phases"]) <= set(BLAME_PHASES)
+    # the pure-span segments, the linked txn lock wait, and the
+    # freeze-window overlap all show up as components
+    for ph in ("dispatch", "quorum", "apply", "ack", "txn_lock",
+               "topology_freeze"):
+        assert ph in doc["phases"], ph
+    # the 50ms lock wait dominates every percentile of this 1-command
+    # distribution — blame NAMES it
+    for pname in ("p50", "p95", "p99"):
+        pe = doc["percentiles"][pname]
+        assert pe["dominant"] == "txn_lock"
+        assert pe["latency_us"] > 50_000        # extent + lock wait
+    txt = format_blame(doc)
+    assert "dominated by txn_lock" in txt
+    s = blame_summary(doc)
+    assert s["p99"] == "txn_lock" and s["p99_us"] > 50_000
+    assert blame_summary(dict(commands=0)) is None
+
+
+def test_console_blame_column_and_health_field():
+    assert "blame" in CLUSTER_HEALTH_FIELDS
+    assert _blame_state({}) == "-"
+    assert _blame_state({"blame": None}) == "-"
+    assert _blame_state({"blame": {"p50": "quorum", "p95": "quorum",
+                                   "p99": "apply",
+                                   "p99_us": 1200.0}}) \
+        == "p99:apply 1200us"
+
+
+# ---------------------------------------------------------------------------
+# the obs CLI: merge + blame over dump files; bundle gains perfetto
+# ---------------------------------------------------------------------------
+
+def test_cli_merge_and_blame_round_trip(tmp_path, capsys):
+    rec, tc = _synthetic_pair()
+    sp = tmp_path / "spans.json"
+    tr = tmp_path / "traces.json"
+    sp.write_text(json.dumps(rec.dump(anchor=ANCHOR)))
+    tr.write_text(json.dumps(tc.dump(anchor=ANCHOR)))
+    out = tmp_path / "merged.perfetto.json"
+    assert obs_main(["merge", str(sp), str(tr), "-o", str(out)]) == 0
+    doc = json.loads(out.read_text())
+    assert doc["otherData"]["traces"] == 2
+    pids = {e["pid"] for e in doc["traceEvents"]}
+    assert SUBSYS_PIDS["txn"] in pids
+    assert SUBSYS_PIDS["topology"] in pids
+    capsys.readouterr()
+    assert obs_main(["blame", str(sp), str(tr)]) == 0
+    assert "dominated by txn_lock" in capsys.readouterr().out
+    # --json emits the raw document
+    assert obs_main(["blame", "--json", str(sp), str(tr)]) == 0
+    assert json.loads(capsys.readouterr().out)["commands"] == 1
+
+
+def test_bundle_gains_merged_perfetto_section(tmp_path):
+    rec, tc = _synthetic_pair()
+    (tmp_path / "spans.json").write_text(
+        json.dumps(rec.dump(anchor=ANCHOR)))
+    (tmp_path / "traces.json").write_text(
+        json.dumps(tc.dump(anchor=ANCHOR)))
+    bundle = assemble_bundle(reason="test", workdir=str(tmp_path))
+    sec = bundle["sections"]
+    assert sec["perfetto"]["otherData"]["traces"] == 2
+    assert "perfetto" in bundle["manifest"]
+    # and the CLI can read the BUNDLE itself (classification by shape)
+    bp = tmp_path / "bundle.json"
+    bp.write_text(json.dumps(bundle))
+    out = tmp_path / "from_bundle.json"
+    assert obs_main(["merge", str(bp), "-o", str(out)]) == 0
+    assert json.loads(out.read_text())["otherData"]["traces"] == 2
+
+
+# ---------------------------------------------------------------------------
+# the chaos schedule: split window + concurrent txns, deterministic
+# ---------------------------------------------------------------------------
+
+def _traced_cluster():
+    shard = ShardedCluster(CFG, 3, 2, txn=True)
+    obs = Observability(
+        span_recorder=SpanRecorder(sample_every=1,
+                                   clock=_scripted_clock()),
+        trace_context=TraceContext(clock=_scripted_clock()))
+    shard.obs = obs
+    kv = ShardedKVS(shard, cap=256)
+    reads_mod.attach(shard)
+    ctl = attach_topology(kv, obs=obs, cooldown_steps=4)
+    attach_coordinator(kv)
+    shard.place_leaders()
+    return shard, kv, ctl, obs
+
+
+def _run_window(shard, ctl, max_steps=300):
+    for _ in range(max_steps):
+        shard.step()
+        ctl.drive()
+        if not ctl.in_window():
+            return
+    raise AssertionError("transition window did not close: "
+                         f"{ctl.status()}")
+
+
+def _chaos_schedule():
+    """Seeded schedule: a txn committing THROUGH an open split window,
+    then a txn whose mapping moves out from under it mid-flight.
+    Returns the merged timeline (sorted JSON) plus a summary."""
+    shard, kv, ctl, obs = _traced_cluster()
+    keys = keys_for_groups(kv.router, 4)
+    h = kv.transact([("put", keys[0][3], b"w"),
+                     ("put", keys[1][3], b"w")])
+    for _ in range(6):
+        if h.done:
+            break
+        shard.step()
+    assert h.committed
+    # a REAL split window over group 0's upper range, with a
+    # cross-group txn riding through it
+    hot = sorted(keys[0])
+    assert ctl.propose_split(hot[len(hot) // 2], hot[-1] + b"\x00", 1)
+    h2 = kv.transact([("put", keys[0][0], b"x"),
+                      ("put", keys[1][1], b"y")])
+    _run_window(shard, ctl)
+    for _ in range(8):
+        if h2.done:
+            break
+        shard.step()
+    assert h2.done
+    # the doomed txn: its key's group mapping moves while in flight
+    keys2 = keys_for_groups(kv.router, 2)
+    ka, kb = keys2[0][0], keys2[1][0]
+    h3 = kv.transact([("put", ka, b"A"), ("put", kb, b"B")])
+    kv.router.install_rule(RangeRule(ka, ka + b"\x00", 1))
+    for _ in range(8):
+        if h3.done:
+            break
+        shard.step()
+    assert h3.done and not h3.committed
+    assert h3.abort_reason == "topology"
+    # the abort DECISION records land a couple of steps after the
+    # handle resolves; their spans retire with them (still a fixed,
+    # deterministic schedule — the sim flips the condition at the
+    # same step every run)
+    for _ in range(20):
+        if (obs.spans.counts()["open"] == 0
+                and obs.tracectx.open_count == 0):
+            break
+        shard.step()
+    merged = merge_timeline([obs.spans.dump(anchor=ANCHOR)],
+                            [obs.tracectx.dump(anchor=ANCHOR)])
+    return (json.dumps(merged, sort_keys=True),
+            dict(spans=obs.spans.counts(),
+                 traces=obs.tracectx.counts(),
+                 dump=obs.tracectx.dump(anchor=ANCHOR)))
+
+
+def test_chaos_schedule_deterministic_closed_and_blamed():
+    blob1, s1 = _chaos_schedule()
+    # every span and every subsystem trace closed — no leaks, even
+    # through the window and the TOPOLOGY abort
+    assert s1["spans"]["open"] == 0
+    assert s1["traces"]["open"] == 0
+    assert s1["traces"]["by_kind"]["topology"] == 1
+    assert s1["traces"]["by_kind"]["txn"] == 3
+    by_id = {t["tid"]: t for t in s1["dump"]["traces"]}
+    win = by_id["topology-0"]
+    assert win["status"] == "done"
+    phases = [p for p, _ in win["phases"]]
+    for ph in ("freeze", "cutover"):
+        assert ph in phases, ph
+    # the TOPOLOGY-aborted txn names the transition window as its
+    # blocking parent and carries the abort reason
+    aborted = [t for t in s1["dump"]["traces"]
+               if t["kind"] == "txn"
+               and t["attrs"].get("reason") == "topology"]
+    assert len(aborted) == 1
+    assert aborted[0]["status"] == "aborted"
+    assert aborted[0]["parent"] == "topology-0"
+    assert [p for p, _ in aborted[0]["phases"]][-1] == "abort"
+    # committed txns closed as committed, with their span links
+    committed = [t for t in s1["dump"]["traces"]
+                 if t["kind"] == "txn" and t["status"] == "committed"]
+    assert committed and all(t["links"] for t in committed)
+    # same seed, fresh cluster -> byte-identical merged timeline
+    blob2, _ = _chaos_schedule()
+    assert blob1 == blob2
+    # and the merged doc carries both planes
+    doc = json.loads(blob1)
+    assert doc["otherData"]["traces"] == 4
+    assert doc["otherData"]["spans"] > 0
+
+
+def test_merged_timeline_includes_watch_deliveries():
+    from rdma_paxos_tpu import streams as streams_mod
+    shard, kv, ctl, obs = _traced_cluster()
+    hub = streams_mod.attach(shard)
+    try:
+        keys = keys_for_groups(kv.router, 2)
+        sub = hub.subscribe(0)
+        for k in keys[0]:
+            kv.put(k, b"V" + k, leader=shard.leader_hint(0))
+        for _ in range(5):
+            shard.step()
+        assert hub.watch.wait_caught_up(
+            {0: hub.tails[0].length()})
+        # one committed cross-group txn for the txn track
+        h = kv.transact([("put", keys[0][0], b"w"),
+                         ("put", keys[1][0], b"w")])
+        for _ in range(6):
+            if h.done:
+                break
+            shard.step()
+        assert h.committed
+        # watch traces retire with the deliveries; give the pump a
+        # beat, then merge — all THREE subsystem tracks present
+        deadline = time.time() + 5
+        while (obs.tracectx.counts()["by_kind"].get("watch", 0) < 1
+               and time.time() < deadline):
+            time.sleep(0.01)
+        assert sub.poll(max_n=64)
+        doc = merge_timeline([obs.spans.dump(anchor=ANCHOR)],
+                             [obs.tracectx.dump(anchor=ANCHOR)])
+        pids = {e["pid"] for e in doc["traceEvents"]}
+        for kind in ("txn", "watch"):
+            assert SUBSYS_PIDS[kind] in pids, kind
+        watch = [t for t in obs.tracectx.dump()["traces"]
+                 if t["kind"] == "watch"]
+        assert watch
+        for t in watch:
+            names = [p for p, _ in t["phases"]]
+            assert names[:1] == ["pump"] and "deliver" in names
+    finally:
+        hub.fail_all("test done")
+
+
+# ---------------------------------------------------------------------------
+# zero-device discipline: tracing changes NOTHING on the step path
+# ---------------------------------------------------------------------------
+
+def test_step_cache_and_outputs_bit_identical_with_tracing():
+    # fresh geometry: exact "adds nothing" set comparison
+    cfg = LogConfig(n_slots=128, slot_bytes=128, window_slots=16,
+                    batch_slots=4)
+
+    def workload(shard, kv):
+        shard.place_leaders()
+        keys = keys_for_groups(kv.router, 3)
+        h = kv.transact([("put", keys[0][0], b"w"),
+                         ("put", keys[1][0], b"w")])
+        for _ in range(6):
+            if h.done:
+                break
+            shard.step()
+        assert h.committed
+        for _ in range(3):
+            shard.step()
+
+    plain = ShardedCluster(cfg, 3, 2, txn=True)
+    kv_p = ShardedKVS(plain, cap=64)
+    attach_coordinator(kv_p)
+    workload(plain, kv_p)
+    keys_before = set(STEP_CACHE)
+
+    traced = ShardedCluster(cfg, 3, 2, txn=True)
+    traced.obs = Observability(
+        span_recorder=SpanRecorder(sample_every=1),
+        trace_context=TraceContext())
+    kv_t = ShardedKVS(traced, cap=64)
+    attach_topology(kv_t, obs=traced.obs, cooldown_steps=2)
+    attach_coordinator(kv_t)
+    workload(traced, kv_t)
+    assert set(STEP_CACHE) == keys_before, (
+        "full tracing must add NOTHING to the step cache")
+    for k in ("term", "commit", "end", "apply", "head", "role"):
+        assert np.array_equal(np.asarray(plain.last[k]),
+                              np.asarray(traced.last[k])), k
+    # and it actually traced: the txn trace retired as committed
+    c = traced.obs.tracectx.counts()
+    assert c["by_kind"].get("txn") == 1 and c["open"] == 0
